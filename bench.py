@@ -1,0 +1,105 @@
+"""Headline benchmark: flagship GPT-89.6M train-step throughput on real hardware.
+
+Runs the reference workload (batch 8 × seq 512 = 4,096 tokens/step, AdamW,
+dropout 0.1 — BASELINE.md) with this framework's TPU path (bf16 compute,
+fused attention when available) on whatever devices are present, and prints
+ONE JSON line:
+
+    {"metric": "tokens_per_sec", "value": ..., "unit": "tokens/s", "vs_baseline": ...}
+
+vs_baseline is relative to the reference's best strategy throughput,
+~27.9k tokens/s for DP/TP on its (unspecified) CUDA-12 GPUs
+(`/root/reference/outputs/dp/log.csv`, SURVEY.md §6).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+BASELINE_TOKENS_PER_SEC = 27_900.0  # reference DP/TP, SURVEY.md §6
+
+
+def main() -> None:
+    import jax
+    import numpy as np
+
+    from dtc_tpu.config.schema import MeshConfig, ModelConfig, OptimConfig, TrainConfig
+    from dtc_tpu.data.synthetic import synthetic_batch_iterator
+    from dtc_tpu.data.prefetch import ShardedPrefetchIterator
+    from dtc_tpu.models.gpt import GPT
+    from dtc_tpu.parallel.mesh import mesh_from_config
+    from dtc_tpu.parallel.sharding import DEFAULT_RULES, batch_spec
+    from dtc_tpu.train.train_step import Batch, create_train_step
+    from dtc_tpu.train.trainer import init_state
+    from dtc_tpu.utils.metrics import mfu
+    from flax import linen as nn
+
+    model_cfg = ModelConfig(
+        vocab_size=50258, d_model=512, n_layers=12, n_heads=16, d_ff=2048,
+        max_seq_len=512, dropout=0.1, param_dtype="float32",
+        compute_dtype="bfloat16", attention="auto",
+    )
+    opt_cfg = OptimConfig(lr=3e-4, weight_decay=0.1, grad_clip=1.0)
+    n_dev = jax.device_count()
+    train_cfg = TrainConfig(
+        seed=0, parallel="dp", batch=8, steps=1, log_every=1, output_dir="",
+        dataset="synthetic", warmup_steps=0, prefetch=2, mesh=MeshConfig(),
+    )
+
+    mesh = mesh_from_config("dp", train_cfg.mesh)
+    model = GPT(model_cfg)
+    rules = DEFAULT_RULES
+
+    warmup_steps, bench_steps = 10, 30
+    with mesh, nn.logical_axis_rules(rules):
+        state = init_state(model, model_cfg, train_cfg, opt_cfg, mesh, rules)
+        step_fn = create_train_step(mesh, model=model)
+        it = ShardedPrefetchIterator(
+            synthetic_batch_iterator(
+                train_cfg.batch, model_cfg.max_seq_len + 1, model_cfg.vocab_size
+            ),
+            mesh, batch_spec(rules), queue_size=4,
+        )
+        key = jax.random.PRNGKey(0)
+
+        for _ in range(warmup_steps):
+            x, y = next(it)
+            key, sub = jax.random.split(key)
+            state, loss = step_fn(state, Batch(x=x, y=y), sub)
+        # Sync via value fetch: on some remote-execution platforms
+        # block_until_ready returns before device work completes, but a
+        # host transfer of the result cannot.
+        float(np.asarray(loss))
+
+        start = time.perf_counter()
+        for _ in range(bench_steps):
+            x, y = next(it)
+            key, sub = jax.random.split(key)
+            state, loss = step_fn(state, Batch(x=x, y=y), sub)
+        final_loss = float(np.asarray(loss))
+        elapsed = time.perf_counter() - start
+
+    step_time = elapsed / bench_steps
+    tokens_per_sec = train_cfg.batch * model_cfg.max_seq_len / step_time
+    u = mfu(model_cfg, train_cfg.batch, model_cfg.max_seq_len, step_time, n_dev)
+    result = {
+        "metric": "tokens_per_sec",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(tokens_per_sec / BASELINE_TOKENS_PER_SEC, 3),
+    }
+    print(json.dumps(result))
+    # Context lines for humans (stderr-free; driver reads the JSON line above).
+    extra = {
+        "step_time_s": round(step_time, 5),
+        "devices": n_dev,
+        "device_kind": jax.devices()[0].device_kind,
+        "mfu": round(u, 4) if u is not None else None,
+        "final_loss": final_loss,
+    }
+    print("# bench-detail:", json.dumps(extra))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,28 @@
+"""dtc_tpu — a TPU-native distributed-training framework.
+
+A ground-up JAX/XLA/Pallas re-design of the capability set of
+``KT19/distributed-training-compare-jax`` (see SURVEY.md): GPT training on
+streamed FineWeb-Edu under data-, tensor-, and pipeline-parallelism — plus
+combined 3D DP×TP×PP, multi-host pods, checkpointing, profiling, and
+long-context (flash / ring) attention, none of which the reference has.
+
+Design principles (TPU-first):
+
+- ONE device mesh with named axes ``("pipe", "data", "model")`` built from
+  slice topology. DP, TP, and DP×TP are *mesh shapes*, not code paths: a
+  single canonical logical-axis rule table maps the model's logical axes to
+  mesh axes, and an axis of size 1 simply means "replicated". (The reference
+  instead branches on a ``parallel: str`` inside the model and reuses a
+  single mesh axis named "data" for both DP and TP —
+  ``/root/reference/parallel/sharding.py:44-57``.)
+- DP/TP/2D train step is one ``jax.jit``; XLA's SPMD partitioner inserts all
+  collectives (ICI all-reduce / all-gather / reduce-scatter) from sharding
+  annotations.
+- PP is an explicit GPipe fill-drain schedule under ``jax.shard_map``,
+  manual over the ``pipe`` axis only — ``data``/``model`` stay under GSPMD —
+  so the same pipeline code composes into 3D DP×TP×PP.
+- Params live in float32, compute in bfloat16 (MXU-native), softmax and loss
+  in float32.
+"""
+
+__version__ = "0.1.0"

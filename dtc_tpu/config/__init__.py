@@ -1,0 +1,16 @@
+from dtc_tpu.config.schema import (
+    MeshConfig,
+    ModelConfig,
+    OptimConfig,
+    TrainConfig,
+)
+from dtc_tpu.config.loader import load_config, load_yaml_dataclass
+
+__all__ = [
+    "MeshConfig",
+    "ModelConfig",
+    "OptimConfig",
+    "TrainConfig",
+    "load_config",
+    "load_yaml_dataclass",
+]

@@ -1,0 +1,85 @@
+"""YAML -> dataclass config loading with strict key validation.
+
+The reference loads YAML with bare ``yaml.safe_load`` and splats it into
+dataclasses (`/root/reference/main.py:14-30`), so a typo'd key is an opaque
+TypeError. Here unknown keys raise with the file path and the set of valid
+keys, and nested dataclasses (``TrainConfig.mesh``) are handled.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from pathlib import Path
+from typing import Any, Type, TypeVar
+
+import yaml
+
+T = TypeVar("T")
+
+
+def _build(cls: Type[T], data: dict[str, Any], source: str) -> T:
+    if not isinstance(data, dict):
+        raise TypeError(f"{source}: expected a mapping for {cls.__name__}, got {type(data)}")
+    fields = {f.name: f for f in dataclasses.fields(cls)}
+    unknown = set(data) - set(fields)
+    if unknown:
+        raise ValueError(
+            f"{source}: unknown key(s) {sorted(unknown)} for {cls.__name__}; "
+            f"valid keys: {sorted(fields)}"
+        )
+    kwargs: dict[str, Any] = {}
+    for name, value in data.items():
+        ftype = fields[name].type
+        # Nested dataclass (e.g. TrainConfig.mesh: MeshConfig) given as a mapping.
+        fcls = _resolve_dataclass(ftype)
+        if fcls is not None and isinstance(value, dict):
+            kwargs[name] = _build(fcls, value, f"{source}.{name}")
+        else:
+            kwargs[name] = value
+    return cls(**kwargs)
+
+
+def _resolve_dataclass(ftype: Any) -> type | None:
+    """Map a (possibly string-annotated) field type to a dataclass, else None."""
+    from dtc_tpu.config import schema
+
+    if isinstance(ftype, str):
+        ftype = getattr(schema, ftype, None)
+    if isinstance(ftype, type) and dataclasses.is_dataclass(ftype):
+        return ftype
+    return None
+
+
+def load_yaml_dataclass(path: str | Path, cls: Type[T], overrides: dict[str, Any] | None = None) -> T:
+    """Load one YAML file into one dataclass, with optional key overrides."""
+    path = Path(path)
+    with open(path) as f:
+        data = yaml.safe_load(f) or {}
+    if overrides:
+        data.update(overrides)
+    return _build(cls, data, str(path))
+
+
+def load_config(
+    train_config_path: str | Path,
+    model_config_path: str | Path | None = None,
+    optim_config_path: str | Path | None = None,
+    model_overrides: dict[str, Any] | None = None,
+):
+    """Load the (train, model, optim) config triple.
+
+    Mirrors the reference's loading scheme (`/root/reference/main.py:13-30`):
+    model/optim config paths default to siblings of the train config named
+    ``model_config.yaml`` / ``optim_config.yaml``.
+    """
+    from dtc_tpu.config.schema import ModelConfig, OptimConfig, TrainConfig
+
+    train_config_path = Path(train_config_path)
+    cfg_dir = train_config_path.parent
+    model_config_path = Path(model_config_path or cfg_dir / "model_config.yaml")
+    optim_config_path = Path(optim_config_path or cfg_dir / "optim_config.yaml")
+
+    train_cfg = load_yaml_dataclass(train_config_path, TrainConfig)
+    model_cfg = load_yaml_dataclass(model_config_path, ModelConfig, overrides=model_overrides)
+    optim_cfg = load_yaml_dataclass(optim_config_path, OptimConfig)
+    return train_cfg, model_cfg, optim_cfg

@@ -1,0 +1,147 @@
+"""Typed configuration schema.
+
+Capability parity with the reference's three frozen dataclasses
+(`/root/reference/config/schema.py:7-38`), extended with what a TPU-native
+framework needs and the reference lacks: explicit mesh-axis sizes (the
+reference encodes parallelism as a single ``parallel: str`` and reuses one
+mesh axis for DP and TP), precision policy, attention implementation choice,
+rematerialisation, data/prefetch knobs, checkpointing, profiling, and
+multi-host (DCN) mesh factors.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any
+
+PyTree = Any
+
+VALID_PARALLEL = ("none", "dp", "tp", "pp", "3d")
+
+
+@dataclass(frozen=True)
+class ModelConfig:
+    """GPT model hyperparameters.
+
+    Mirrors `/root/reference/config/schema.py:7-16` minus the ``parallel``
+    field: the model here is strategy-agnostic — parallelism is expressed
+    entirely through mesh shape + logical-axis rules, never branched on
+    inside model code.
+    """
+
+    vocab_size: int
+    d_model: int
+    n_layers: int
+    n_heads: int
+    d_ff: int
+    max_seq_len: int
+    dropout: float = 0.0
+    # --- TPU-native extensions ---
+    param_dtype: str = "float32"    # master weights
+    compute_dtype: str = "bfloat16"  # MXU-native matmul dtype
+    attention: str = "auto"          # auto | dense | flash | ring
+    attention_block_q: int = 512     # flash attention query block
+    attention_block_kv: int = 512    # flash attention kv block
+    remat: bool = False              # jax.checkpoint each block (HBM <-> FLOPs)
+    vocab_pad_multiple: int = 128    # pad vocab so the TP-sharded axis tiles evenly
+
+    def __post_init__(self) -> None:
+        if self.d_model % self.n_heads != 0:
+            raise ValueError(
+                f"d_model={self.d_model} not divisible by n_heads={self.n_heads}"
+            )
+        if self.attention not in ("auto", "dense", "flash", "ring"):
+            raise ValueError(f"unknown attention impl {self.attention!r}")
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    @property
+    def padded_vocab_size(self) -> int:
+        """Vocab rounded up so embedding/lm_head shard evenly under TP and
+        lane-align on the MXU. Padded logit columns are masked to -1e9 in
+        the head, so the loss is mathematically unchanged."""
+        m = max(self.vocab_pad_multiple, 1)
+        return ((self.vocab_size + m - 1) // m) * m
+
+
+@dataclass(frozen=True)
+class OptimConfig:
+    """Optimizer hyperparameters (`/root/reference/config/schema.py:19-23`),
+    plus LR-schedule knobs the reference lacks (it runs constant LR)."""
+
+    lr: float
+    weight_decay: float
+    grad_clip: float
+    b1: float = 0.9
+    b2: float = 0.999
+    schedule: str = "constant"  # constant | warmup_cosine
+    warmup_steps: int = 0
+    min_lr_ratio: float = 0.1
+
+    def __post_init__(self) -> None:
+        if self.schedule not in ("constant", "warmup_cosine"):
+            raise ValueError(f"unknown schedule {self.schedule!r}")
+
+
+@dataclass(frozen=True)
+class MeshConfig:
+    """Device-mesh shape: ICI axis sizes per parallelism kind, plus DCN
+    (inter-slice) factors for multi-slice pods.
+
+    A value of 0 means "auto": filled in from the ``parallel`` strategy and
+    the device count by :func:`dtc_tpu.parallel.mesh.resolve_mesh_shape`.
+    """
+
+    pipe: int = 0
+    data: int = 0
+    model: int = 0
+    # DCN (slow, inter-slice) factors; total axis size = ici * dcn.
+    dcn_pipe: int = 1
+    dcn_data: int = 1
+    dcn_model: int = 1
+
+
+@dataclass(frozen=True)
+class TrainConfig:
+    """Training-run configuration.
+
+    Field-compatible with the reference's TrainConfig
+    (`/root/reference/config/schema.py:26-38`) — the same YAML files load —
+    with TPU-native extensions.
+    """
+
+    seed: int
+    parallel: str
+    batch: int
+    steps: int
+    log_every: int
+    output_dir: str
+    pp_microbatches: int = 1
+    # --- TPU-native extensions ---
+    mesh: MeshConfig = field(default_factory=MeshConfig)
+    dataset: str = "fineweb"     # fineweb | synthetic
+    warmup_steps: int = 5        # untimed warmup steps (reference uses 5)
+    prefetch: int = 2            # host->device prefetch depth; 0 = synchronous
+    sync_every_step: bool = False  # block on loss each step (reference behavior)
+    checkpoint_every: int = 0    # 0 = disabled
+    checkpoint_dir: str = ""     # default: <output_dir>/checkpoints
+    resume: bool = True          # resume from latest checkpoint if present
+    profile_start: int = 0       # capture jax.profiler trace [start, stop)
+    profile_stop: int = 0
+    multihost: bool = False      # call jax.distributed.initialize()
+
+    def __post_init__(self) -> None:
+        if self.parallel not in VALID_PARALLEL:
+            raise ValueError(
+                f"unknown parallel strategy {self.parallel!r}; expected one of {VALID_PARALLEL}"
+            )
+        if self.dataset not in ("fineweb", "synthetic"):
+            raise ValueError(f"unknown dataset {self.dataset!r}")
+        if self.pp_microbatches < 1:
+            raise ValueError("pp_microbatches must be >= 1")
+        if self.batch % self.pp_microbatches != 0:
+            raise ValueError(
+                f"batch={self.batch} not divisible by pp_microbatches={self.pp_microbatches}"
+            )

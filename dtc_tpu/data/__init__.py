@@ -1,0 +1,5 @@
+from dtc_tpu.data.packing import pack_token_stream
+from dtc_tpu.data.synthetic import synthetic_batch_iterator
+from dtc_tpu.data.prefetch import ShardedPrefetchIterator
+
+__all__ = ["pack_token_stream", "synthetic_batch_iterator", "ShardedPrefetchIterator"]

@@ -1,0 +1,38 @@
+"""Streamed FineWeb-Edu batches (reference data path).
+
+Same source, split, and packing semantics as
+`/root/reference/data/fineweb_edu.py:15-39` — HuggingFace streaming of
+``HuggingFaceFW/fineweb-edu`` train split, per-document tokenization,
+boundary-free concatenation — but the packing is delegated to
+:func:`dtc_tpu.data.packing.pack_token_stream` and tokenization can run in a
+background thread so the (network + CPU)-bound work overlaps device compute
+instead of sitting on the training critical path (the reference tokenizes
+synchronously inside the step loop, SURVEY.md §3.4).
+"""
+
+from __future__ import annotations
+
+from typing import Iterator
+
+import numpy as np
+
+from dtc_tpu.data.packing import pack_token_stream
+from dtc_tpu.data.tokenizer import get_tokenizer
+
+
+def _document_tokens(tokenizer) -> Iterator[list[int]]:
+    from datasets import load_dataset  # network-bound import kept local
+
+    ds = load_dataset("HuggingFaceFW/fineweb-edu", split="train", streaming=True)
+    for item in ds:
+        yield tokenizer.encode(item["text"])
+
+
+def fineweb_batch_iterator(
+    batch_size: int,
+    seq_len: int,
+    tokenizer=None,
+) -> Iterator[np.ndarray]:
+    """Yield (batch_size, seq_len) int32 batches from streamed FineWeb-Edu."""
+    tokenizer = tokenizer or get_tokenizer()
+    yield from pack_token_stream(_document_tokens(tokenizer), batch_size, seq_len)

@@ -1,0 +1,32 @@
+"""Token-stream packing, factored out as a pure generator.
+
+Keeps the reference's exact packing semantics for loss-curve parity
+(`/root/reference/data/fineweb_edu.py:25-39`): documents are tokenized,
+concatenated into one flat buffer with NO separator tokens or boundary
+masking, and cut into dense ``(batch, seq_len)`` int32 arrays in stream
+order. Unlike the reference, the packer is independent of the data source
+and tokenizer, so it is unit-testable without network access.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Iterator
+
+import numpy as np
+
+
+def pack_token_stream(
+    token_chunks: Iterable[list[int] | np.ndarray],
+    batch_size: int,
+    seq_len: int,
+) -> Iterator[np.ndarray]:
+    """Pack an iterable of token chunks into dense (batch_size, seq_len) batches."""
+    need = batch_size * seq_len
+    buffer = np.empty(0, dtype=np.int32)
+    for chunk in token_chunks:
+        chunk = np.asarray(chunk, dtype=np.int32)
+        buffer = np.concatenate([buffer, chunk]) if buffer.size else chunk
+        while buffer.size >= need:
+            batch = buffer[:need].reshape(batch_size, seq_len)
+            buffer = buffer[need:]
+            yield batch

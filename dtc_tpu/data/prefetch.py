@@ -1,0 +1,86 @@
+"""Host->device batch feeding with background prefetch.
+
+The reference feeds the device synchronously: `next(iterator)` tokenizes on
+the host, then `jnp.array(...)` transfers, all inside the timed step loop
+(`/root/reference/train/train.py:74-78`). On a pod that starves the chips.
+
+Here a background thread runs the host-side iterator and eagerly places
+batches on the mesh with their NamedSharding, keeping `queue_size` batches
+in flight. Multi-host runs go through
+`jax.make_array_from_process_local_data`, so each process feeds only its
+shard of the global batch.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Iterator
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
+
+
+def put_batch(x: np.ndarray, mesh: Mesh, spec: P) -> jax.Array:
+    """Place a host batch on the mesh (multi-host aware)."""
+    sharding = NamedSharding(mesh, spec)
+    if jax.process_count() > 1:
+        return jax.make_array_from_process_local_data(sharding, x)
+    return jax.device_put(x, sharding)
+
+
+class ShardedPrefetchIterator:
+    """Wrap a host batch iterator; yield (x, y) device arrays.
+
+    Splits each (batch, seq_len+1) token array into next-token-prediction
+    inputs/targets (x = [:, :-1], y = [:, 1:], as the reference does at
+    /root/reference/train/train.py:76-77) and device_puts with the batch
+    PartitionSpec. ``queue_size=0`` degrades to fully synchronous feeding.
+    """
+
+    def __init__(
+        self,
+        host_iterator: Iterator[np.ndarray],
+        mesh: Mesh,
+        spec: P,
+        queue_size: int = 2,
+    ):
+        self._it = host_iterator
+        self._mesh = mesh
+        self._spec = spec
+        self._queue_size = queue_size
+        self._queue: queue.Queue | None = None
+        self._err: BaseException | None = None
+        if queue_size > 0:
+            self._queue = queue.Queue(maxsize=queue_size)
+            self._thread = threading.Thread(target=self._worker, daemon=True)
+            self._thread.start()
+
+    def _split_put(self, batch: np.ndarray):
+        x = put_batch(np.ascontiguousarray(batch[:, :-1]), self._mesh, self._spec)
+        y = put_batch(np.ascontiguousarray(batch[:, 1:]), self._mesh, self._spec)
+        return x, y
+
+    def _worker(self):
+        try:
+            for batch in self._it:
+                self._queue.put(self._split_put(batch))
+        except BaseException as e:  # surfaced on the consumer side
+            self._err = e
+        finally:
+            self._queue.put(None)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._queue is None:
+            return self._split_put(next(self._it))
+        item = self._queue.get()
+        if item is None:
+            if self._err is not None:
+                raise self._err
+            raise StopIteration
+        return item

@@ -1,0 +1,41 @@
+"""Deterministic synthetic token data.
+
+The reference has no offline data path at all — every run (and any test)
+needs live HuggingFace streaming (`/root/reference/data/fineweb_edu.py:21`).
+This iterator produces a reproducible, learnable token stream for tests and
+benchmarks: a Zipf-ish unigram distribution with short-range repetition
+structure so the loss actually decreases (pure uniform noise would pin the
+loss at log(vocab)).
+"""
+
+from __future__ import annotations
+
+from typing import Iterator
+
+import numpy as np
+
+
+def synthetic_batch_iterator(
+    batch_size: int,
+    seq_len: int,
+    vocab_size: int,
+    seed: int = 0,
+) -> Iterator[np.ndarray]:
+    """Yield deterministic (batch_size, seq_len) int32 batches.
+
+    Batch ``i`` for a given (seed, shape, vocab) is identical across runs,
+    processes, and mesh shapes — the property the cross-strategy parity
+    tests rely on.
+    """
+    i = 0
+    while True:
+        rng = np.random.default_rng(np.random.SeedSequence([seed, i]))
+        # Zipf-distributed unigrams, clipped into vocab.
+        base = rng.zipf(1.3, size=(batch_size, seq_len)).astype(np.int64)
+        tokens = (base - 1) % vocab_size
+        # Inject copy structure: each position repeats the token 8 back with p=0.5.
+        copy_mask = rng.random((batch_size, seq_len)) < 0.5
+        shifted = np.roll(tokens, 8, axis=1)
+        tokens = np.where(copy_mask, shifted, tokens)
+        yield tokens.astype(np.int32)
+        i += 1

@@ -1,0 +1,49 @@
+"""Tokenizer with an offline fallback.
+
+The reference unconditionally downloads the GPT-2 tokenizer
+(`/root/reference/data/fineweb_edu.py:8-12`), which hangs in a zero-egress
+environment. Here the HF load is attempted local-files-first, then online
+only if the environment allows; otherwise a deterministic byte-level
+fallback with the same padded vocab size (50258) keeps every model shape
+identical to the reference workload.
+"""
+
+from __future__ import annotations
+
+import os
+
+#: GPT-2 vocab (50257) + the reference's added <pad> token
+#: (/root/reference/data/fineweb_edu.py:10-11) => 50258.
+GPT2_PADDED_VOCAB = 50258
+
+
+class ByteTokenizer:
+    """UTF-8 byte fallback tokenizer, vocab padded to match GPT-2+<pad>."""
+
+    def __init__(self, vocab_size: int = GPT2_PADDED_VOCAB):
+        self._vocab_size = vocab_size
+
+    def __len__(self) -> int:
+        return self._vocab_size
+
+    def encode(self, text: str) -> list[int]:
+        return list(text.encode("utf-8"))
+
+    def decode(self, ids) -> str:
+        return bytes(int(i) % 256 for i in ids).decode("utf-8", errors="replace")
+
+
+def get_tokenizer(allow_download: bool | None = None):
+    """GPT-2 tokenizer with a <pad> token added (vocab 50258), reference
+    parity with `/root/reference/data/fineweb_edu.py:8-12`; falls back to
+    :class:`ByteTokenizer` when HF files are unavailable offline."""
+    if allow_download is None:
+        allow_download = os.environ.get("DTC_ALLOW_DOWNLOAD", "0") == "1"
+    try:
+        from transformers import AutoTokenizer
+
+        tok = AutoTokenizer.from_pretrained("gpt2", local_files_only=not allow_download)
+        tok.add_special_tokens({"pad_token": "<pad>"})
+        return tok
+    except Exception:
+        return ByteTokenizer()

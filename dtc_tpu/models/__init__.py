@@ -1,0 +1,3 @@
+from dtc_tpu.models.gpt import GPT, param_count
+
+__all__ = ["GPT", "param_count"]

@@ -1,0 +1,242 @@
+"""GPT model — flax linen, strategy-agnostic, pipeline-splittable.
+
+Capability parity with the reference model family
+(`/root/reference/model/GPTModel.py`, `TransformerBlock.py`,
+`CausalSelfAttention.py`, `MLP.py`): decoder-only pre-LN GPT-2-style
+transformer with learned absolute position embeddings, separate q/k/v
+projections, GELU MLP, dropout, and a pipeline-splittable embed/stage/head
+decomposition with scan-over-layers parameter stacking — the structure both
+TP sharding rules and PP stage-chunking key on
+(`/root/reference/model/GPTModel.py:25-82`).
+
+TPU-native differences:
+
+- The split is *module-level* (GPTEmbed / GPTStage / GPTHead composed by
+  GPT), not method-level: pipeline stages apply the sub-modules standalone
+  with their own param subtrees — no ``method=`` plumbing — and the full
+  param tree is already {"embed", "stage", "head"}, so the PP layout is a
+  leaf reshape, not a re-init (the reference re-inits per stage with
+  different keys, `/root/reference/train/train.py:143-161`).
+- No ``parallel: str`` branches in model code. Activations carry *logical*
+  axis names via ``nn.with_logical_constraint``; the active rule table +
+  mesh shape decide physical sharding (cf. reference's per-strategy branches
+  at `/root/reference/model/CausalSelfAttention.py:28-31,49-50`).
+- Mixed precision: fp32 master params, bf16 (MXU-native) matmuls, fp32
+  LayerNorm/softmax/loss.
+- Attention is a pluggable op (dense / Pallas flash / ring); causality lives
+  inside the op — no (1,1,T,T) mask tensor threaded through the model
+  (cf. `/root/reference/model/GPTModel.py:50-51`).
+- Optional per-block rematerialisation (``remat``) to trade FLOPs for HBM.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from dtc_tpu.config.schema import ModelConfig
+from dtc_tpu.ops.attention import causal_attention
+
+
+def _dtype(name: str):
+    return {"float32": jnp.float32, "bfloat16": jnp.bfloat16, "float16": jnp.float16}[name]
+
+
+class CausalSelfAttention(nn.Module):
+    cfg: ModelConfig
+
+    @nn.compact
+    def __call__(self, x: jax.Array, *, train: bool) -> jax.Array:
+        cfg = self.cfg
+        b, t, _ = x.shape
+        cdtype = _dtype(cfg.compute_dtype)
+        pdtype = _dtype(cfg.param_dtype)
+
+        def dense(name):
+            return nn.Dense(cfg.d_model, name=name, dtype=cdtype, param_dtype=pdtype)
+
+        q = dense("q_proj")(x).reshape(b, t, cfg.n_heads, cfg.head_dim)
+        k = dense("k_proj")(x).reshape(b, t, cfg.n_heads, cfg.head_dim)
+        v = dense("v_proj")(x).reshape(b, t, cfg.n_heads, cfg.head_dim)
+        # Head axis is the TP-sharded axis: under TP each device holds
+        # n_heads / model_parallelism heads and attention is embarrassingly
+        # parallel until out_proj's row-parallel all-reduce.
+        q = nn.with_logical_constraint(q, ("batch", "seq", "heads", "head_dim"))
+        k = nn.with_logical_constraint(k, ("batch", "seq", "heads", "head_dim"))
+        v = nn.with_logical_constraint(v, ("batch", "seq", "heads", "head_dim"))
+
+        out = causal_attention(
+            q, k, v,
+            impl=cfg.attention,
+            block_q=cfg.attention_block_q,
+            block_kv=cfg.attention_block_kv,
+        )
+        out = out.reshape(b, t, cfg.d_model)
+        out = dense("out_proj")(out)
+        # Row-parallel output: constraining back to embed-replicated makes
+        # XLA insert the TP all-reduce here.
+        out = nn.with_logical_constraint(out, ("batch", "seq", "embed"))
+        return out
+
+
+class MLP(nn.Module):
+    cfg: ModelConfig
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        cfg = self.cfg
+        cdtype = _dtype(cfg.compute_dtype)
+        pdtype = _dtype(cfg.param_dtype)
+        h = nn.Dense(cfg.d_ff, name="fc1", dtype=cdtype, param_dtype=pdtype)(x)
+        h = nn.gelu(h)
+        h = nn.with_logical_constraint(h, ("batch", "seq", "mlp"))  # column-parallel
+        h = nn.Dense(cfg.d_model, name="fc2", dtype=cdtype, param_dtype=pdtype)(h)
+        h = nn.with_logical_constraint(h, ("batch", "seq", "embed"))  # row-parallel all-reduce
+        return h
+
+
+class Block(nn.Module):
+    """Pre-LN transformer block: x + Attn(LN(x)); x + MLP(LN(x))."""
+
+    cfg: ModelConfig
+
+    @nn.compact
+    def __call__(self, x: jax.Array, *, train: bool) -> jax.Array:
+        cfg = self.cfg
+
+        def ln(name):
+            # LayerNorm in fp32 for numerical stability.
+            return nn.LayerNorm(name=name, dtype=jnp.float32, param_dtype=jnp.float32)
+
+        h = ln("ln_1")(x).astype(_dtype(cfg.compute_dtype))
+        x = x + nn.Dropout(cfg.dropout, deterministic=not train)(
+            CausalSelfAttention(cfg, name="attn")(h, train=train)
+        )
+        h = ln("ln_2")(x).astype(_dtype(cfg.compute_dtype))
+        x = x + nn.Dropout(cfg.dropout, deterministic=not train)(MLP(cfg, name="mlp")(h))
+        return nn.with_logical_constraint(x, ("batch", "seq", "embed"))
+
+
+class _ScanBlock(nn.Module):
+    """Carry adapter so Block can run under nn.scan."""
+
+    cfg: ModelConfig
+    train: bool
+
+    @nn.compact
+    def __call__(self, h: jax.Array, _):
+        return Block(self.cfg)(h, train=self.train), None
+
+
+class GPTEmbed(nn.Module):
+    """Token + learned-position embedding with dropout (pipeline stage 0 head-end).
+
+    ``lookup="onehot"`` computes the token lookup as one_hot(x) @ table — a
+    matmul instead of a gather. The pipeline step uses it because XLA's SPMD
+    partitioner cannot partition a sharded gather inside a partially-manual
+    (shard_map over "pipe") region, while a matmul partitions fine — and it
+    rides the MXU. Both lookups share identical params.
+    """
+
+    cfg: ModelConfig
+    lookup: str = "gather"
+
+    @nn.compact
+    def __call__(self, x: jax.Array, *, train: bool = True) -> jax.Array:
+        cfg = self.cfg
+        pdtype = _dtype(cfg.param_dtype)
+        _, t = x.shape
+        wte = nn.Embed(cfg.padded_vocab_size, cfg.d_model, name="wte", param_dtype=pdtype)
+        if self.lookup == "onehot":
+            onehot = jax.nn.one_hot(x, cfg.padded_vocab_size, dtype=_dtype(cfg.compute_dtype))
+            tok = onehot @ wte.embedding.astype(_dtype(cfg.compute_dtype))
+        else:
+            tok = wte(x)
+        # Positions are a static prefix: slice the table instead of gathering.
+        wpe = nn.Embed(cfg.max_seq_len, cfg.d_model, name="wpe", param_dtype=pdtype)
+        pos = wpe.embedding[:t][None, :, :]
+        h = (tok + pos).astype(_dtype(cfg.compute_dtype))
+        h = nn.Dropout(cfg.dropout, deterministic=not train)(h)
+        return nn.with_logical_constraint(h, ("batch", "seq", "embed"))
+
+
+class GPTStage(nn.Module):
+    """``n_layers`` stacked blocks — a pipeline stage's layer chunk.
+
+    nn.scan stacks every block param with a leading "layers" axis — the
+    layout the TP rule table keys on and the PP (stages, layers/stage, ...)
+    reshape relies on (mirrors `/root/reference/model/GPTModel.py:55-67`).
+    """
+
+    cfg: ModelConfig
+    n_layers: int
+
+    @nn.compact
+    def __call__(self, h: jax.Array, *, train: bool = True) -> jax.Array:
+        cls = _ScanBlock
+        if self.cfg.remat:
+            cls = nn.remat(cls, prevent_cse=False)
+        scanned = nn.scan(
+            cls,
+            variable_axes={"params": 0},
+            split_rngs={"params": True, "dropout": True},
+            length=self.n_layers,
+            metadata_params={nn.PARTITION_NAME: "layers"},
+        )(self.cfg, train, name="blocks")
+        h, _ = scanned(h, None)
+        return h
+
+
+class GPTHead(nn.Module):
+    """Final LayerNorm + LM head (pipeline last-stage tail)."""
+
+    cfg: ModelConfig
+
+    @nn.compact
+    def __call__(self, h: jax.Array) -> jax.Array:
+        cfg = self.cfg
+        h = nn.LayerNorm(name="ln_f", dtype=jnp.float32, param_dtype=jnp.float32)(h)
+        logits = nn.Dense(
+            cfg.padded_vocab_size,
+            name="lm_head",
+            dtype=_dtype(cfg.compute_dtype),
+            param_dtype=_dtype(cfg.param_dtype),
+        )(h.astype(_dtype(cfg.compute_dtype)))
+        if cfg.padded_vocab_size != cfg.vocab_size:
+            # Mask pad columns: contributes exp(-1e9)=0 to any softmax, so
+            # losses/samples over the padded vocab equal the unpadded ones.
+            col = jax.lax.broadcasted_iota(jnp.int32, (cfg.padded_vocab_size,), 0)
+            logits = jnp.where(col < cfg.vocab_size, logits, -1e9).astype(logits.dtype)
+        return nn.with_logical_constraint(logits, ("batch", "seq", "vocab_out"))
+
+
+class GPT(nn.Module):
+    """Full decoder-only GPT. Param tree: {"embed": …, "stage": …, "head": …} —
+    already the pipeline decomposition, so PP is a leaf reshape away."""
+
+    cfg: ModelConfig
+
+    def setup(self):
+        self.embed = GPTEmbed(self.cfg)
+        self.stage = GPTStage(self.cfg, self.cfg.n_layers)
+        self.head = GPTHead(self.cfg)
+
+    def __call__(self, x: jax.Array, *, train: bool = True) -> jax.Array:
+        h = self.embed(x, train=train)
+        h = self.stage(h, train=train)
+        return self.head(h)
+
+
+def param_count(cfg: ModelConfig) -> int:
+    """Exact parameter count from config (no tracing needed)."""
+    d, v, L, f, s = cfg.d_model, cfg.padded_vocab_size, cfg.n_layers, cfg.d_ff, cfg.max_seq_len
+    embed = v * d + s * d
+    per_block = (
+        4 * (d * d + d)        # q,k,v,out projections
+        + (d * f + f)          # fc1
+        + (f * d + d)          # fc2
+        + 4 * d                # ln_1, ln_2 scale+bias
+    )
+    head = 2 * d + (d * v + v)  # ln_f + lm_head
+    return embed + L * per_block + head

@@ -1,0 +1,3 @@
+from dtc_tpu.ops.attention import causal_attention
+
+__all__ = ["causal_attention"]

@@ -1,0 +1,80 @@
+"""Attention ops with pluggable implementations.
+
+The reference hard-codes one O(T²)-memory einsum attention that materialises
+the full ``(B, H, T, T)`` score tensor and an additive ``-1e9`` mask built in
+the embedding layer (`/root/reference/model/CausalSelfAttention.py:34-42`,
+`/root/reference/model/GPTModel.py:50-51`). Here attention is an *op* with
+three implementations behind one interface:
+
+- ``dense``  — XLA einsum path, fp32 softmax, mask fused via ``where`` on an
+  iota comparison (no (1,1,T,T) mask buffer travels through the model).
+  Reference semantics; used for CPU tests and as the autodiff baseline.
+- ``flash``  — blockwise Pallas TPU kernel (ops/flash_attention.py): O(T)
+  memory, VMEM-tiled, for long sequences.
+- ``ring``   — sequence-parallel ring attention (ops/ring_attention.py):
+  KV blocks rotate over the mesh via ppermute while queries stay put.
+
+``auto`` picks flash on TPU when shapes are tile-friendly, else dense.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e9  # matches the reference's additive mask value
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.devices()[0].platform == "tpu"
+    except Exception:
+        return False
+
+
+def dense_causal_attention(q: jax.Array, k: jax.Array, v: jax.Array) -> jax.Array:
+    """Reference-semantics causal attention.
+
+    Args are ``(B, T, H, D)``. Scores and softmax run in float32 regardless
+    of input dtype (bf16-safe); output is cast back to the input dtype.
+    """
+    b, t, h, d = q.shape
+    scale = d ** -0.5
+    scores = jnp.einsum(
+        "bthd,bshd->bhts", q, k, preferred_element_type=jnp.float32
+    ) * scale
+    tpos = jax.lax.broadcasted_iota(jnp.int32, (t, t), 0)
+    spos = jax.lax.broadcasted_iota(jnp.int32, (t, t), 1)
+    scores = jnp.where(spos <= tpos, scores, NEG_INF)
+    weights = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
+    out = jnp.einsum("bhts,bshd->bthd", weights.astype(v.dtype), v)
+    return out.astype(q.dtype)
+
+
+def causal_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    impl: str = "auto",
+    block_q: int = 512,
+    block_kv: int = 512,
+) -> jax.Array:
+    """Dispatch causal self-attention over ``(B, T, H, D)`` tensors."""
+    if impl == "auto":
+        t, d = q.shape[1], q.shape[3]
+        if _on_tpu() and t >= 256 and t % 128 == 0 and d % 128 == 0:
+            impl = "flash"
+        else:
+            impl = "dense"
+    if impl == "dense":
+        return dense_causal_attention(q, k, v)
+    if impl == "flash":
+        from dtc_tpu.ops.flash_attention import flash_causal_attention
+
+        return flash_causal_attention(q, k, v, block_q=block_q, block_kv=block_kv)
+    if impl == "ring":
+        from dtc_tpu.ops.ring_attention import ring_causal_attention
+
+        return ring_causal_attention(q, k, v)
+    raise ValueError(f"unknown attention impl {impl!r}")

@@ -1,0 +1,21 @@
+from dtc_tpu.parallel.mesh import AXIS_NAMES, build_mesh, resolve_mesh_shape
+from dtc_tpu.parallel.sharding import (
+    DEFAULT_RULES,
+    batch_spec,
+    logical_to_spec,
+    param_logical_axes,
+    param_specs,
+    shard_params,
+)
+
+__all__ = [
+    "AXIS_NAMES",
+    "build_mesh",
+    "resolve_mesh_shape",
+    "DEFAULT_RULES",
+    "batch_spec",
+    "logical_to_spec",
+    "param_logical_axes",
+    "param_specs",
+    "shard_params",
+]

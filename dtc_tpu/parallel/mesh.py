@@ -1,0 +1,113 @@
+"""Device-mesh construction from TPU slice topology.
+
+This is the framework's "communication backend" in the sense of SURVEY.md
+§2.3: on TPU there is no NCCL layer to manage — the backend IS the mesh.
+Which collectives ride ICI vs DCN is decided entirely by how the mesh is
+laid out over the physical topology, so this module is where that planning
+lives:
+
+- ``("pipe", "data", "model")`` named axes, with ``model`` (tensor
+  parallelism, the most latency-sensitive collectives: per-layer
+  all-reduce/all-gather) placed innermost so `mesh_utils.create_device_mesh`
+  maps it onto nearest-neighbour ICI links.
+- Multi-slice pods use `create_hybrid_device_mesh`, where the ``dcn_*``
+  factors of :class:`MeshConfig` say which axes span the (slow) DCN between
+  slices — conventionally ``data`` (gradient all-reduce once per step
+  amortises over the step) and never ``model``.
+
+The reference builds a 1-D mesh with a single axis named "data" and reuses
+it to mean DP or TP depending on a string (`/root/reference/train/train.py:29`);
+here every strategy — including combined 3D — is just a shape on this one
+3-axis mesh.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import numpy as np
+from jax.experimental import mesh_utils
+from jax.sharding import Mesh
+
+from dtc_tpu.config.schema import MeshConfig
+
+# Axis order: pipe outermost (stage handoffs are once per microbatch-clock),
+# data middle (one gradient all-reduce per step), model innermost (per-layer
+# collectives want the fastest links).
+AXIS_NAMES = ("pipe", "data", "model")
+PIPE, DATA, MODEL = AXIS_NAMES
+
+
+def resolve_mesh_shape(parallel: str, num_devices: int, mesh: MeshConfig) -> tuple[int, int, int]:
+    """Resolve ``(pipe, data, model)`` ICI axis sizes.
+
+    Zero entries in ``mesh`` are auto-filled from the strategy: the strategy's
+    own axis absorbs all devices not claimed by explicit entries. Validates
+    that the product covers every device (a partially used slice wastes
+    chips silently otherwise).
+    """
+    sizes = {PIPE: mesh.pipe, DATA: mesh.data, MODEL: mesh.model}
+    primary = {"dp": DATA, "tp": MODEL, "pp": PIPE, "none": DATA, "3d": None}[parallel]
+
+    if parallel == "3d":
+        # 3D requires explicit sizes; default unset axes to 1.
+        sizes = {k: (v or 1) for k, v in sizes.items()}
+    else:
+        explicit = {k: v for k, v in sizes.items() if v > 0}
+        known = math.prod(explicit.values()) if explicit else 1
+        if primary in explicit:
+            sizes = {k: explicit.get(k, 1) for k in sizes}
+        else:
+            if num_devices % known != 0:
+                raise ValueError(
+                    f"explicit mesh axes {explicit} do not divide device count {num_devices}"
+                )
+            sizes = {k: explicit.get(k, 1) for k in sizes}
+            sizes[primary] = num_devices // known
+
+    shape = (sizes[PIPE], sizes[DATA], sizes[MODEL])
+    if math.prod(shape) != num_devices:
+        raise ValueError(
+            f"mesh shape pipe×data×model = {shape} (= {math.prod(shape)}) "
+            f"must equal the device count {num_devices}"
+        )
+    return shape
+
+
+def build_mesh(
+    shape: tuple[int, int, int],
+    *,
+    devices: list | None = None,
+    dcn_shape: tuple[int, int, int] | None = None,
+) -> Mesh:
+    """Build the 3-axis device mesh.
+
+    ``shape`` is the ICI (intra-slice) shape. ``dcn_shape``, when any entry
+    is > 1, is the DCN (inter-slice) factor per axis; the total axis size is
+    the product, and `create_hybrid_device_mesh` keeps DCN hops on the
+    outermost dimension of each axis so ICI collectives never cross slices.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    if dcn_shape is not None and any(d > 1 for d in dcn_shape):
+        device_array = mesh_utils.create_hybrid_device_mesh(
+            shape, dcn_shape, devices=devices, allow_split_physical_axes=True
+        )
+    else:
+        try:
+            device_array = mesh_utils.create_device_mesh(
+                shape, devices=devices, allow_split_physical_axes=True
+            )
+        except (ValueError, NotImplementedError):
+            # Topology-unaware fallback (e.g. virtual CPU devices).
+            device_array = np.asarray(devices).reshape(shape)
+    return Mesh(device_array, axis_names=AXIS_NAMES)
+
+
+def mesh_from_config(parallel: str, mesh_cfg: MeshConfig, devices: list | None = None) -> Mesh:
+    """One-call mesh construction used by the trainer and tests."""
+    devices = list(devices if devices is not None else jax.devices())
+    dcn = (mesh_cfg.dcn_pipe, mesh_cfg.dcn_data, mesh_cfg.dcn_model)
+    n_ici = len(devices) // math.prod(dcn)
+    shape = resolve_mesh_shape(parallel, n_ici, mesh_cfg)
+    return build_mesh(shape, devices=devices, dcn_shape=dcn)

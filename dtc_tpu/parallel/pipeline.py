@@ -1,0 +1,236 @@
+"""Pipeline parallelism: GPipe fill-drain under ``jax.shard_map``.
+
+Same schedule semantics as the reference for loss parity — fill-drain over
+``num_microbatches + num_stages - 1`` clock ticks expressed as a
+``lax.scan``, activations shifted one stage forward per tick with
+``lax.ppermute``, loss = (sum over microbatches) / M replicated via
+``psum`` (`/root/reference/train/create_train_step.py:55-195`). Unlike the
+reference, labels and the bubble valid-flag do NOT travel the ring: validity
+is a static function of (stage, tick) and labels are pipe-replicated, so the
+ring carries exactly one tensor per tick (a third of the reference's
+per-tick collectives).
+
+TPU-native re-design:
+
+- ``jax.shard_map`` manual over the ``pipe`` mesh axis only (the reference
+  uses legacy ``pmap``, which owns *all* devices). The ``data`` and
+  ``model`` axes stay under GSPMD inside the pipeline body, so combined 3D
+  DP×TP×PP falls out of this one code path.
+- Per-stage params are the full model's params with every block leaf
+  reshaped ``(L, …) -> (S, L/S, …)`` and the leading axis sharded
+  ``P("pipe")`` — one logical parameter set, not S re-initialised copies
+  (cf. `/root/reference/train/train.py:143-161`).
+- embed/head params are pipe-replicated; their grads are ``psum``-ed over
+  the pipe axis inside the shard_map, so every stage applies the *true*
+  gradient and replicas never drift (the reference instead lets AdamW decay
+  unused replicas — SURVEY.md §7 "PP optimizer semantics").
+- The optimizer update runs *outside* the shard_map in plain GSPMD land:
+  stage params/opt-state shard over pipe, embed/head replicate.
+- Backward is plain ``jax.value_and_grad`` through the clock scan; autodiff
+  transposes ``ppermute`` to the reverse ring, so gradients drain backwards
+  without a hand-written schedule.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+from jax import lax
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
+from jax.tree_util import tree_map_with_path
+
+from dtc_tpu.models.gpt import GPTEmbed, GPTHead, GPTStage, _dtype
+from dtc_tpu.parallel.sharding import (
+    DEFAULT_RULES,
+    logical_axes_for_path,
+    logical_to_spec,
+)
+
+PyTree = Any
+
+
+# --------------------------------------------------------------------------
+# Param layout: (L, ...) block leaves  <->  (S, L/S, ...) stacked stages
+# --------------------------------------------------------------------------
+
+def pp_stack_params(params: PyTree, num_stages: int) -> PyTree:
+    """Reshape every stage-chunk leaf (L, …) -> (S, L/S, …). embed/head pass through."""
+
+    def stack(leaf):
+        l = leaf.shape[0]
+        assert l % num_stages == 0, f"n_layers={l} not divisible by {num_stages} stages"
+        return leaf.reshape(num_stages, l // num_stages, *leaf.shape[1:])
+
+    return {**params, "stage": jax.tree.map(stack, params["stage"])}
+
+
+def pp_unstack_params(params: PyTree) -> PyTree:
+    """Inverse of :func:`pp_stack_params` (for checkpoints / eval)."""
+
+    def unstack(leaf):
+        return leaf.reshape(leaf.shape[0] * leaf.shape[1], *leaf.shape[2:])
+
+    return {**params, "stage": jax.tree.map(unstack, params["stage"])}
+
+
+def pp_param_specs(params_pp: PyTree, rules: Sequence[tuple[str, str | None]] = DEFAULT_RULES) -> PyTree:
+    """Spec tree for stacked-PP params: stage leaves gain a leading
+    "stages"->pipe axis; embed/head keep their table specs (pipe-replicated)."""
+
+    def get(path, leaf):
+        names = [str(getattr(k, "key", k)) for k in path]
+        axes = logical_axes_for_path(path)
+        if names[0] == "stage":
+            axes = ("stages",) + axes
+        if len(axes) != leaf.ndim:
+            raise ValueError(f"{'/'.join(names)}: axes {axes} vs rank {leaf.ndim}")
+        return logical_to_spec(axes, rules)
+
+    return tree_map_with_path(get, params_pp)
+
+
+# --------------------------------------------------------------------------
+# The pipelined train step
+# --------------------------------------------------------------------------
+
+def create_pp_train_step(
+    model,
+    mesh: Mesh,
+    *,
+    num_microbatches: int,
+    rules: Sequence[tuple[str, str | None]] = DEFAULT_RULES,
+):
+    """Build the jitted PP (or 3D DP×TP×PP) train step.
+
+    Expects ``state.params`` in stacked-PP layout (:func:`pp_stack_params`).
+    Returns ``train_step(state, batch, rng) -> (state, loss)``.
+    """
+    cfg = model.cfg
+    num_stages = mesh.shape["pipe"]
+    assert cfg.n_layers % num_stages == 0
+    layers_per_stage = cfg.n_layers // num_stages
+    m = num_microbatches
+
+    embed_mod = GPTEmbed(cfg, lookup="onehot")
+    stage_mod = GPTStage(cfg, layers_per_stage)
+    head_mod = GPTHead(cfg)
+
+    # Stage i hands its activations to stage i+1 (fill-drain ring).
+    perm = [(i, i + 1) for i in range(num_stages - 1)]
+
+    def fwd_bwd(params: PyTree, x_mb: jax.Array, y_mb: jax.Array, rng: jax.Array):
+        """Per-stage program (manual over "pipe"; data/model stay GSPMD)."""
+        stage_id = lax.axis_index("pipe")
+        is_first = stage_id == 0
+        is_last = stage_id == num_stages - 1
+
+        # Local stage chunk: leading stacked axis has local extent 1.
+        stage_params = jax.tree.map(lambda a: jnp.squeeze(a, 0), params["stage"])
+
+        mb, t = x_mb.shape[1], x_mb.shape[2]
+        h_zeros = jnp.zeros((mb, t, cfg.d_model), dtype=_dtype(cfg.compute_dtype))
+        stage_rng = jax.random.fold_in(rng, stage_id)
+        n_ticks = m + num_stages - 1
+
+        # DESIGN NOTE — uniform collective schedule. Every device executes
+        # the exact same op sequence: no lax.cond on stage-varying
+        # predicates anywhere in the pipeline body (the reference conds
+        # per-stage under pmap, /root/reference/train/create_train_step.py:105-155).
+        # In a lockstep pipeline the per-tick ppermute is a barrier, so a
+        # bubble tick costs one stage-time whether the device idles (cond)
+        # or computes masked garbage (where) — uniformity is free. It also
+        # keeps GSPMD's auto-axis collectives (CE all-reduce over "data",
+        # logsumexp over vocab-sharded "model") out of divergent branches,
+        # which some runtimes (the CPU in-process communicator) require.
+        # Embed is hoisted BEFORE the clock scan and head/loss AFTER it, so
+        # the scan body is exactly: stage chunk + ring shift.
+        # Fill-drain invariant: stage s works on microbatch (tick - s), so
+        # validity is static in (stage_id, tick) and nothing but the
+        # activation tensor ever rides the ring (the reference also
+        # ppermutes labels and a valid flag — 3x the per-tick collectives).
+        def loss_fn(embed_p, stage_p, head_p):
+            # 1) Embed all M microbatches up front (consumed by stage 0;
+            #    masked out elsewhere — cost hidden behind pipeline fill).
+            h0 = embed_mod.apply(
+                {"params": embed_p},
+                x_mb.reshape(m * mb, t),
+                train=True,
+                rngs={"dropout": jax.random.fold_in(stage_rng, 0)},
+            ).reshape(m, mb, t, cfg.d_model)
+
+            # 2) Clock scan: stage chunk + single ppermute per tick.
+            def body(h_buf, tick):
+                mb_idx = tick - stage_id  # microbatch this stage works on
+                valid = jnp.logical_and(mb_idx >= 0, mb_idx < m)
+                h_in = lax.dynamic_index_in_dim(h0, jnp.minimum(tick, m - 1), keepdims=False)
+                h_cur = jnp.where(is_first, h_in, h_buf)
+                h_stage = stage_mod.apply(
+                    {"params": stage_p}, h_cur, train=True,
+                    rngs={"dropout": jax.random.fold_in(stage_rng, tick + 1)},
+                )
+                h_out = jnp.where(valid, h_stage, h_zeros)
+                if num_stages == 1:
+                    h_next = h_zeros
+                else:
+                    h_next = lax.ppermute(h_out, "pipe", perm)
+                return h_next, h_out
+
+            _, h_ticks = lax.scan(body, h_zeros, jnp.arange(n_ticks))
+
+            # 3) Head + loss after the scan, on every stage (masked to the
+            #    last): the last stage emits microbatch j at tick S-1+j, a
+            #    STATIC window of h_ticks.
+            from dtc_tpu.train.train_step import cross_entropy_loss
+
+            h_last = lax.slice_in_dim(h_ticks, num_stages - 1, num_stages - 1 + m, axis=0)
+            logits = head_mod.apply({"params": head_p}, h_last.reshape(m * mb, t, cfg.d_model))
+            loss = cross_entropy_loss(logits, y_mb.reshape(m * mb, t))
+            # Return the LOCAL loss (nonzero on the last stage only). Each
+            # device seeds AD with its own local scalar and the ppermute
+            # transposes carry the last stage's cotangents back down the
+            # ring, so grads equal d(sum of local losses)/d(params) — the
+            # true global gradient — without differentiating through a
+            # psum (whose transpose is an all-reduce of a constant, an op
+            # with no data dependencies that concurrency-aware schedulers
+            # may hoist into a race with the ring collectives).
+            return jnp.where(is_last, loss, 0.0)
+
+        local_loss, grads = jax.value_and_grad(loss_fn, argnums=(0, 1, 2))(
+            params["embed"], stage_params, params["head"]
+        )
+        # Replicate the global mean loss onto every stage (host logging).
+        loss = lax.psum(local_loss, "pipe")
+        # embed/head are logically shared: psum makes every stage hold the
+        # true global gradient (nonzero only on first/last stage locally).
+        g_embed = lax.psum(grads[0], "pipe")
+        g_head = lax.psum(grads[2], "pipe")
+        g_stage = jax.tree.map(lambda a: a[None], grads[1])
+        return loss, {"embed": g_embed, "stage": g_stage, "head": g_head}
+
+    param_pipe_specs = {"embed": P(), "stage": P("pipe"), "head": P()}
+    sharded_fwd_bwd = jax.shard_map(
+        fwd_bwd,
+        mesh=mesh,
+        in_specs=(param_pipe_specs, P(), P(), P()),
+        out_specs=(P(), param_pipe_specs),
+        axis_names={"pipe"},
+        check_vma=False,
+    )
+
+    @partial(jax.jit, donate_argnums=(0,))
+    def train_step(state, batch, rng: jax.Array):
+        b, t = batch.x.shape
+        x_mb = batch.x.reshape(m, b // m, t)
+        y_mb = batch.y.reshape(m, b // m, t)
+        x_mb = nn.with_logical_constraint(x_mb, ("microbatch", "batch", "seq"))
+        y_mb = nn.with_logical_constraint(y_mb, ("microbatch", "batch", "seq"))
+        loss, grads = sharded_fwd_bwd(state.params, x_mb, y_mb, rng)
+        state = state.apply_gradients(grads=grads)
+        return state, loss
+
+    return train_step

@@ -1,0 +1,178 @@
+"""Logical-axis sharding: one declarative rule table instead of per-strategy code.
+
+The reference decides parameter sharding by substring-matching flax param
+paths against a ``parallel: str`` (`/root/reference/parallel/sharding.py:17-62`)
+and scatters per-strategy ``with_sharding_constraint`` branches through the
+model (`/root/reference/model/MLP.py:16-24`). Here the model names its axes
+*logically* and a single rule table maps logical -> mesh axes:
+
+- DP is the mesh having ``data > 1`` (batch axis sharded, params replicated
+  because ``model == 1`` makes every param spec a no-op),
+- TP (Megatron-style) is ``model > 1`` (column-parallel qkv/fc1, row-parallel
+  out_proj/fc2, vocab-parallel lm_head — XLA inserts the all-reduces),
+- DP×TP needs no new rules at all.
+
+The table below is data, exhaustively unit-tested in
+``tests/test_sharding.py`` — an unknown param path is an error, so the table
+can never silently drift from the model.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import jax
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
+from jax.tree_util import tree_map_with_path
+
+PyTree = Any
+
+# --------------------------------------------------------------------------
+# Logical axis names. "Rules" map these to mesh axis names (or None).
+# --------------------------------------------------------------------------
+
+#: Canonical logical->mesh rules. Axes not listed map to None (replicated /
+#: unsharded). This single table covers DP, TP, DP×TP and the GSPMD part of
+#: 3D; strategy choice lives entirely in the mesh *shape*.
+DEFAULT_RULES: tuple[tuple[str, str | None], ...] = (
+    ("batch", "data"),        # batch dim of activations and inputs
+    ("heads", "model"),       # attention head axis (activations)
+    ("qkv", "model"),         # column-parallel projection outputs
+    ("mlp", "model"),         # column-parallel MLP hidden
+    ("vocab_out", "model"),   # vocab-parallel lm_head
+    ("embed", None),          # d_model axis
+    ("seq", None),            # sequence axis (ring attention remaps this)
+    ("head_dim", None),
+    ("layers", None),         # scan-over-layers axis (PP reshapes it, see pipeline.py)
+    ("stages", "pipe"),       # leading axis of stacked pipeline-stage params
+    ("vocab_in", None),       # wte rows (gather-indexed; kept replicated)
+    ("seqpos", None),         # wpe rows
+    ("microbatch", None),     # leading microbatch axis of PP inputs
+)
+
+#: Rules for ring-attention / sequence parallelism: the sequence axis of
+#: activations is sharded over "model" and KV blocks rotate via ppermute.
+RING_RULES: tuple[tuple[str, str | None], ...] = tuple(
+    (name, "model") if name == "seq" else (name, axis) for name, axis in DEFAULT_RULES
+)
+
+
+def logical_to_spec(axes: Sequence[str | None], rules: Sequence[tuple[str, str | None]]) -> P:
+    """Map a tuple of logical axis names to a PartitionSpec under ``rules``."""
+    table = dict(rules)
+    out = []
+    for ax in axes:
+        if ax is None:
+            out.append(None)
+        else:
+            if ax not in table:
+                raise KeyError(f"logical axis {ax!r} not covered by rules {sorted(table)}")
+            out.append(table[ax])
+    return P(*out)
+
+
+def batch_spec(rules: Sequence[tuple[str, str | None]] = DEFAULT_RULES) -> P:
+    """PartitionSpec for an int32 ``(batch, seq)`` token batch."""
+    return logical_to_spec(("batch", "seq"), rules)
+
+
+# --------------------------------------------------------------------------
+# Param-path -> logical axes table for the GPT model in dtc_tpu.models.gpt.
+#
+# Keys match on the *suffix* of the flax param path; the scan-over-layers
+# transform stacks every block param with a leading "layers" axis (mirroring
+# the reference's rank-3 layout, /root/reference/model/GPTModel.py:57-65),
+# which is what makes both TP specs and PP stage-chunking mechanical.
+# --------------------------------------------------------------------------
+
+PARAM_AXES_TABLE: tuple[tuple[tuple[str, ...], tuple[str | None, ...]], ...] = (
+    (("wte", "embedding"), ("vocab_in", "embed")),
+    (("wpe", "embedding"), ("seqpos", "embed")),
+    (("ln_f", "scale"), ("embed",)),
+    (("ln_f", "bias"), ("embed",)),
+    (("lm_head", "kernel"), ("embed", "vocab_out")),
+    (("lm_head", "bias"), ("vocab_out",)),
+    # --- per-block params; leading "layers" axis from nn.scan ---
+    (("ln_1", "scale"), ("layers", "embed")),
+    (("ln_1", "bias"), ("layers", "embed")),
+    (("ln_2", "scale"), ("layers", "embed")),
+    (("ln_2", "bias"), ("layers", "embed")),
+    (("q_proj", "kernel"), ("layers", "embed", "qkv")),
+    (("q_proj", "bias"), ("layers", "qkv")),
+    (("k_proj", "kernel"), ("layers", "embed", "qkv")),
+    (("k_proj", "bias"), ("layers", "qkv")),
+    (("v_proj", "kernel"), ("layers", "embed", "qkv")),
+    (("v_proj", "bias"), ("layers", "qkv")),
+    (("out_proj", "kernel"), ("layers", "qkv", "embed")),
+    (("out_proj", "bias"), ("layers", "embed")),
+    (("fc1", "kernel"), ("layers", "embed", "mlp")),
+    (("fc1", "bias"), ("layers", "mlp")),
+    (("fc2", "kernel"), ("layers", "mlp", "embed")),
+    (("fc2", "bias"), ("layers", "embed")),
+)
+
+
+def _path_names(path: tuple) -> tuple[str, ...]:
+    names = []
+    for k in path:
+        if hasattr(k, "key"):
+            names.append(str(k.key))
+        elif hasattr(k, "name"):
+            names.append(str(k.name))
+        elif hasattr(k, "idx"):
+            names.append(str(k.idx))
+        else:
+            names.append(str(k))
+    return tuple(names)
+
+
+def logical_axes_for_path(path: tuple) -> tuple[str | None, ...]:
+    names = _path_names(path)
+    for suffix, axes in PARAM_AXES_TABLE:
+        if names[-len(suffix):] == suffix:
+            return axes
+    raise KeyError(
+        f"param path {'/'.join(names)} has no entry in PARAM_AXES_TABLE — "
+        "add one (sharding must be explicit for every param)"
+    )
+
+
+def param_logical_axes(params: PyTree) -> PyTree:
+    """Tree of logical-axes tuples, same structure as ``params``."""
+
+    def get(path, leaf):
+        axes = logical_axes_for_path(path)
+        if len(axes) != leaf.ndim:
+            raise ValueError(
+                f"param {'/'.join(_path_names(path))} has rank {leaf.ndim} "
+                f"but table gives axes {axes}"
+            )
+        return axes
+
+    return tree_map_with_path(get, params)
+
+
+def param_specs(params: PyTree, rules: Sequence[tuple[str, str | None]] = DEFAULT_RULES) -> PyTree:
+    """Tree of PartitionSpecs for the param tree under ``rules``."""
+    axes_tree = param_logical_axes(params)
+    return jax.tree.map(
+        lambda axes: logical_to_spec(axes, rules),
+        axes_tree,
+        is_leaf=lambda x: isinstance(x, tuple) and all(isinstance(a, (str, type(None))) for a in x),
+    )
+
+
+def shard_params(
+    params: PyTree, mesh: Mesh, rules: Sequence[tuple[str, str | None]] = DEFAULT_RULES
+) -> tuple[PyTree, PyTree]:
+    """Place ``params`` on the mesh per the rule table.
+
+    Returns ``(sharded_params, spec_tree)`` — same contract as the
+    reference's ``get_sharded_params`` (`/root/reference/parallel/sharding.py:11`).
+    """
+    specs = param_specs(params, rules)
+    shardings = jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
+                             is_leaf=lambda x: isinstance(x, P))
+    sharded = jax.device_put(params, shardings)
+    return sharded, specs

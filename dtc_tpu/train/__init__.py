@@ -1,0 +1,4 @@
+from dtc_tpu.train.optimizer import create_optimizer
+from dtc_tpu.train.train_step import Batch, create_train_step
+
+__all__ = ["create_optimizer", "Batch", "create_train_step"]

@@ -1,0 +1,34 @@
+"""Optimizer construction.
+
+Reference parity: ``clip_by_global_norm(grad_clip)`` chained into AdamW
+(`/root/reference/train/create_optimizer.py:8-12`), constant LR by default.
+Adds an optional linear-warmup + cosine-decay schedule (the reference has
+none), which longer TPU runs want.
+"""
+
+from __future__ import annotations
+
+import optax
+
+from dtc_tpu.config.schema import OptimConfig
+
+
+def create_optimizer(cfg: OptimConfig, total_steps: int = 0) -> optax.GradientTransformation:
+    if cfg.schedule == "constant":
+        lr = cfg.lr
+    elif cfg.schedule == "warmup_cosine":
+        if total_steps <= 0:
+            raise ValueError("warmup_cosine schedule needs total_steps > 0")
+        lr = optax.warmup_cosine_decay_schedule(
+            init_value=0.0,
+            peak_value=cfg.lr,
+            warmup_steps=cfg.warmup_steps,
+            decay_steps=total_steps,
+            end_value=cfg.lr * cfg.min_lr_ratio,
+        )
+    else:  # pragma: no cover - schema validates
+        raise ValueError(cfg.schedule)
+    return optax.chain(
+        optax.clip_by_global_norm(cfg.grad_clip),
+        optax.adamw(learning_rate=lr, b1=cfg.b1, b2=cfg.b2, weight_decay=cfg.weight_decay),
+    )

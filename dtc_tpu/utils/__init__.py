@@ -1,0 +1,4 @@
+from dtc_tpu.utils.metrics import gpt_step_flops, mfu, peak_flops_per_chip
+from dtc_tpu.utils.logging import CSVLogger
+
+__all__ = ["gpt_step_flops", "mfu", "peak_flops_per_chip", "CSVLogger"]

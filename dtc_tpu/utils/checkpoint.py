@@ -1,0 +1,59 @@
+"""Sharding-aware checkpoint / resume via Orbax.
+
+The reference never persists anything but the CSV log (SURVEY.md §5
+"Checkpoint / resume: absent"). Orbax restores arrays directly into their
+NamedShardings, so resume works across mesh shapes as long as the logical
+param tree matches.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any
+
+import jax
+import numpy as np
+
+PyTree = Any
+
+
+class CheckpointManager:
+    def __init__(self, directory: str):
+        import orbax.checkpoint as ocp
+
+        self._dir = os.path.abspath(directory)
+        os.makedirs(self._dir, exist_ok=True)
+        self._mgr = ocp.CheckpointManager(
+            self._dir, options=ocp.CheckpointManagerOptions(max_to_keep=3)
+        )
+
+    def save(self, step: int, state: PyTree) -> None:
+        import orbax.checkpoint as ocp
+
+        self._mgr.save(step, args=ocp.args.StandardSave(state))
+
+    def latest_step(self) -> int | None:
+        return self._mgr.latest_step()
+
+    def restore(self, state_like: PyTree, step: int | None = None) -> PyTree:
+        """Restore into the sharding/structure of ``state_like``."""
+        import orbax.checkpoint as ocp
+
+        step = step if step is not None else self._mgr.latest_step()
+        if step is None:
+            raise FileNotFoundError(f"no checkpoint under {self._dir}")
+
+        def as_restore_arg(x):
+            if isinstance(x, jax.Array):
+                return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding)
+            return x
+
+        target = jax.tree.map(as_restore_arg, state_like)
+        restored = self._mgr.restore(step, args=ocp.args.StandardRestore(target))
+        return restored
+
+    def wait(self) -> None:
+        self._mgr.wait_until_finished()
+
+    def close(self) -> None:
+        self._mgr.close()

@@ -1,0 +1,38 @@
+"""Multi-host (pod) support.
+
+The reference is strictly single-process — no ``jax.distributed.initialize``
+anywhere (SURVEY.md §2.2 "Multi-host"). Here multi-host is first-class:
+initialize once at entry, then every process builds the same global mesh and
+feeds its local shard of the batch (see ``data/prefetch.py``); logging and
+checkpoint writes happen on process 0 only.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+
+
+def maybe_initialize_distributed(multihost: bool) -> None:
+    """Initialize the JAX distributed runtime when running multi-process.
+
+    Safe to call unconditionally: no-ops unless ``multihost`` is set or the
+    standard cluster env (JAX_COORDINATOR_ADDRESS / TPU pod metadata) marks
+    this as a multi-process run.
+    """
+    if jax.process_count() > 1:
+        return  # already initialized
+    env_says_cluster = bool(
+        os.environ.get("JAX_COORDINATOR_ADDRESS") or os.environ.get("COORDINATOR_ADDRESS")
+    )
+    if not (multihost or env_says_cluster):
+        return
+    try:
+        jax.distributed.initialize()
+    except Exception as e:  # single-process fallback keeps local runs working
+        print(f"[dtc_tpu] jax.distributed.initialize() skipped: {e}")
+
+
+def is_lead_process() -> bool:
+    return jax.process_index() == 0

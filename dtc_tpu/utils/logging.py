@@ -1,0 +1,37 @@
+"""Run logging.
+
+CSV schema is byte-compatible with the reference's ``log.csv``
+(columns ``step, elapsed_time, loss`` with cumulative elapsed_time,
+`/root/reference/train/train.py:98-102`) so the reference's plot tooling —
+and our ``plot.py`` — reads either. Unlike the reference (which buffers
+everything in lists and writes once at exit), rows are appended
+incrementally: a crash at step 4900 keeps 4899 rows.
+"""
+
+from __future__ import annotations
+
+import csv
+import os
+from typing import IO
+
+
+class CSVLogger:
+    def __init__(self, path: str, fieldnames: tuple[str, ...] = ("step", "elapsed_time", "loss")):
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self._path = path
+        self._fieldnames = fieldnames
+        self._fh: IO | None = open(path, "w", newline="")
+        self._writer = csv.DictWriter(self._fh, fieldnames=fieldnames)
+        self._writer.writeheader()
+
+    def log(self, **row) -> None:
+        self._writer.writerow({k: row.get(k, "") for k in self._fieldnames})
+
+    def flush(self) -> None:
+        if self._fh:
+            self._fh.flush()
+
+    def close(self) -> None:
+        if self._fh:
+            self._fh.close()
+            self._fh = None

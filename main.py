@@ -1,0 +1,42 @@
+"""CLI entry point.
+
+Flag-compatible with the reference (`/root/reference/main.py:10-12`):
+``python main.py --train_config_path configs/train_config_dp.yaml``.
+Unlike the reference's two-way dispatch (`main.py:38-57`), every strategy —
+dp, tp, pp, and the new combined 3d — routes into the ONE trainer; strategy
+is mesh shape.
+"""
+
+from __future__ import annotations
+
+import click
+
+from dtc_tpu.config.loader import load_config
+from dtc_tpu.train.trainer import train
+
+
+@click.command()
+@click.option("--train_config_path", default="configs/train_config_dp.yaml")
+@click.option("--model_config_path", default=None)
+@click.option("--optim_config_path", default=None)
+def main(train_config_path: str, model_config_path: str | None, optim_config_path: str | None):
+    train_cfg, model_cfg, opt_cfg = load_config(
+        train_config_path, model_config_path, optim_config_path
+    )
+
+    if train_cfg.dataset == "fineweb":
+        # vocab_size comes from the tokenizer, as in /root/reference/main.py:17-18.
+        from dtc_tpu.data.tokenizer import get_tokenizer
+
+        from dataclasses import replace
+
+        model_cfg = replace(model_cfg, vocab_size=len(get_tokenizer()))
+
+    import jax
+
+    print(f"Running `{train_cfg.parallel}` on {jax.device_count()} devices.")
+    train(train_cfg, model_cfg, opt_cfg)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,55 @@
+"""Offline analysis: overlay loss curves + compare wall-clock per strategy.
+
+Parity with `/root/reference/plot.py:6-39`, reading the same
+``outputs/{dp,tp,pp}/log.csv`` schema (plus ``3d`` when present) and writing
+``outputs/loss.png`` and ``outputs/average_elapsed_time.png``. Fixes the
+reference's quirk of bar-charting the SUM of cumulative elapsed times
+(`/root/reference/plot.py:29-39`, see SURVEY.md §2.1): here the bar is the
+actual total wall-clock (final cumulative elapsed_time).
+"""
+
+from __future__ import annotations
+
+import os
+
+STRATEGIES = ("dp", "tp", "pp", "3d")
+
+
+def main(output_root: str = "outputs") -> None:
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+    import pandas as pd
+
+    runs = {}
+    for s in STRATEGIES:
+        path = os.path.join(output_root, s, "log.csv")
+        if os.path.exists(path):
+            runs[s] = pd.read_csv(path)
+    if not runs:
+        raise SystemExit(f"no log.csv found under {output_root}/{{{','.join(STRATEGIES)}}}")
+
+    fig, ax = plt.subplots(figsize=(8, 5))
+    for s, df in runs.items():
+        ax.plot(df["step"], df["loss"], label=s, linewidth=0.8)
+    ax.set_xlabel("step")
+    ax.set_ylabel("loss")
+    ax.set_title("Training loss by parallelism strategy")
+    ax.legend()
+    fig.tight_layout()
+    fig.savefig(os.path.join(output_root, "loss.png"), dpi=150)
+
+    fig, ax = plt.subplots(figsize=(6, 5))
+    names = list(runs)
+    totals = [float(df["elapsed_time"].iloc[-1]) for df in runs.values()]
+    ax.bar(names, totals)
+    ax.set_ylabel("total wall-clock (s)")
+    ax.set_title("Total training time by strategy")
+    fig.tight_layout()
+    fig.savefig(os.path.join(output_root, "average_elapsed_time.png"), dpi=150)
+    print(f"wrote {output_root}/loss.png and {output_root}/average_elapsed_time.png")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,86 @@
+"""Test harness: 8 virtual CPU devices standing in for a TPU slice.
+
+The reference has no tests and no simulated-mesh story (SURVEY.md §4); here
+every multi-device code path (GSPMD DP/TP, shard_map PP, 3D) runs on an
+8-fake-device CPU mesh via --xla_force_host_platform_device_count.
+
+NOTE: the axon sitecustomize registers the TPU platform at interpreter
+startup and overrides JAX_PLATFORMS, so we must force CPU via
+jax.config.update AFTER import — and XLA_FLAGS before first backend use.
+"""
+
+import os
+import sys
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "")
+    + " --xla_force_host_platform_device_count=8"
+    # The thunk-runtime CPU executor runs independent collectives
+    # concurrently in nondeterministic per-device order, which can deadlock
+    # the in-process rendezvous (e.g. a loss psum racing backward-pass
+    # ppermutes in the pipeline step). The TPU runtime serializes
+    # collectives per device stream, so this is a CPU-test-only concern.
+    + " --xla_cpu_use_thunk_runtime=false"
+)
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+from dtc_tpu.config.schema import MeshConfig, ModelConfig, OptimConfig, TrainConfig  # noqa: E402
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _assert_eight_devices():
+    assert jax.device_count() == 8, (
+        f"tests need 8 virtual CPU devices, got {jax.device_count()}"
+    )
+
+
+@pytest.fixture
+def tiny_model_cfg():
+    # Divisibility: n_heads=4 and d_model=64 shard over model=2/4;
+    # n_layers=4 splits over pipe=2/4.
+    return ModelConfig(
+        vocab_size=97,
+        d_model=64,
+        n_layers=4,
+        n_heads=4,
+        d_ff=128,
+        max_seq_len=32,
+        dropout=0.0,
+        param_dtype="float32",
+        compute_dtype="float32",
+        attention="dense",
+    )
+
+
+@pytest.fixture
+def opt_cfg():
+    return OptimConfig(lr=1e-3, weight_decay=0.1, grad_clip=1.0)
+
+
+def make_train_cfg(parallel: str, **kw) -> TrainConfig:
+    defaults = dict(
+        seed=0,
+        parallel=parallel,
+        batch=8,
+        steps=4,
+        log_every=2,
+        output_dir="",
+        dataset="synthetic",
+        warmup_steps=0,
+        prefetch=0,
+        mesh=MeshConfig(),
+    )
+    defaults.update(kw)
+    return TrainConfig(**defaults)
+
+
+@pytest.fixture
+def train_cfg_factory():
+    return make_train_cfg

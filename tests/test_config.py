@@ -1,0 +1,62 @@
+"""Config loading + mesh-shape resolution."""
+
+import pytest
+
+from dtc_tpu.config.loader import load_config, load_yaml_dataclass
+from dtc_tpu.config.schema import MeshConfig, ModelConfig, TrainConfig
+from dtc_tpu.parallel.mesh import resolve_mesh_shape
+
+
+def test_load_reference_compatible_yaml(tmp_path):
+    # The reference's train-config fields load unchanged
+    # (cf. /root/reference/configs/train_config_pp.yaml).
+    p = tmp_path / "t.yaml"
+    p.write_text(
+        "seed: 0\nparallel: pp\nbatch: 8\nsteps: 5000\nlog_every: 50\n"
+        "output_dir: outputs/pp\npp_microbatches: 2\n"
+    )
+    cfg = load_yaml_dataclass(p, TrainConfig)
+    assert cfg.parallel == "pp" and cfg.pp_microbatches == 2
+
+
+def test_unknown_key_raises(tmp_path):
+    p = tmp_path / "t.yaml"
+    p.write_text("seed: 0\nparallel: dp\nbatch: 8\nsteps: 1\nlog_every: 1\noutput_dir: o\ntypo_key: 1\n")
+    with pytest.raises(ValueError, match="typo_key"):
+        load_yaml_dataclass(p, TrainConfig)
+
+
+def test_nested_mesh_key(tmp_path):
+    p = tmp_path / "t.yaml"
+    p.write_text(
+        "seed: 0\nparallel: 3d\nbatch: 8\nsteps: 1\nlog_every: 1\noutput_dir: o\n"
+        "mesh:\n  pipe: 2\n  data: 2\n  model: 2\n"
+    )
+    cfg = load_yaml_dataclass(p, TrainConfig)
+    assert (cfg.mesh.pipe, cfg.mesh.data, cfg.mesh.model) == (2, 2, 2)
+
+
+def test_repo_configs_load():
+    train_cfg, model_cfg, opt_cfg = load_config("configs/train_config_dp.yaml")
+    assert model_cfg.d_model == 512 and model_cfg.n_layers == 12
+    assert opt_cfg.lr == pytest.approx(3e-4)
+    t3, _, _ = load_config("configs/train_config_3d.yaml")
+    assert t3.mesh.pipe == 2
+
+
+def test_model_config_validation():
+    with pytest.raises(ValueError):
+        ModelConfig(vocab_size=10, d_model=10, n_layers=1, n_heads=3, d_ff=4, max_seq_len=8)
+
+
+def test_resolve_mesh_shapes():
+    m = MeshConfig()
+    assert resolve_mesh_shape("dp", 8, m) == (1, 8, 1)
+    assert resolve_mesh_shape("tp", 8, m) == (1, 1, 8)
+    assert resolve_mesh_shape("pp", 8, m) == (8, 1, 1)
+    assert resolve_mesh_shape("none", 1, m) == (1, 1, 1)
+    assert resolve_mesh_shape("3d", 8, MeshConfig(pipe=2, data=2, model=2)) == (2, 2, 2)
+    # dp with an explicit tp factor: dp absorbs the rest
+    assert resolve_mesh_shape("dp", 8, MeshConfig(model=2)) == (1, 4, 2)
+    with pytest.raises(ValueError):
+        resolve_mesh_shape("3d", 8, MeshConfig(pipe=2, data=2, model=1))

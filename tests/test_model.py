@@ -1,0 +1,85 @@
+"""Model structure, causality, and parameter accounting."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dtc_tpu.config.schema import ModelConfig
+from dtc_tpu.models.gpt import GPT, param_count
+
+
+def _init(cfg, batch=2):
+    model = GPT(cfg)
+    x = jnp.ones((batch, cfg.max_seq_len), dtype=jnp.int32)
+    params = model.init({"params": jax.random.PRNGKey(0)}, x, train=False)["params"]
+    return model, params
+
+
+def test_forward_shapes(tiny_model_cfg):
+    model, params = _init(tiny_model_cfg)
+    x = jnp.zeros((2, 16), dtype=jnp.int32)
+    logits = model.apply({"params": params}, x, train=False)
+    assert logits.shape == (2, 16, tiny_model_cfg.padded_vocab_size)
+    # pad columns are masked to -1e9 => zero probability
+    assert float(logits[..., tiny_model_cfg.vocab_size:].max()) <= -1e8
+
+
+def test_param_tree_is_pipeline_decomposed(tiny_model_cfg):
+    _, params = _init(tiny_model_cfg)
+    assert set(params.keys()) == {"embed", "stage", "head"}
+    # scan-over-layers: every block leaf has leading n_layers axis
+    kernels = jax.tree.leaves(params["stage"])
+    assert all(k.shape[0] == tiny_model_cfg.n_layers for k in kernels)
+
+
+def test_param_count_matches_init(tiny_model_cfg):
+    _, params = _init(tiny_model_cfg)
+    n = sum(int(np.prod(p.shape)) for p in jax.tree.leaves(params))
+    assert n == param_count(tiny_model_cfg)
+
+
+def test_reference_workload_is_89_6m():
+    # The reference model is ~89.6M params (SURVEY.md header; BASELINE.md).
+    cfg = ModelConfig(
+        vocab_size=50258, d_model=512, n_layers=12, n_heads=16, d_ff=2048,
+        max_seq_len=512, dropout=0.1,
+    )
+    assert abs(param_count(cfg) / 1e6 - 89.6) < 0.5
+
+
+def test_causality(tiny_model_cfg):
+    """Changing a future token must not change logits at earlier positions."""
+    model, params = _init(tiny_model_cfg)
+    rng = np.random.default_rng(0)
+    x = rng.integers(0, tiny_model_cfg.vocab_size, size=(1, 16)).astype(np.int32)
+    x2 = x.copy()
+    x2[0, 10] = (x2[0, 10] + 1) % tiny_model_cfg.vocab_size
+    l1 = model.apply({"params": params}, jnp.array(x), train=False)
+    l2 = model.apply({"params": params}, jnp.array(x2), train=False)
+    np.testing.assert_allclose(l1[0, :10], l2[0, :10], atol=1e-5)
+    assert not np.allclose(l1[0, 10:], l2[0, 10:], atol=1e-5)
+
+
+def test_initial_loss_near_uniform(tiny_model_cfg):
+    """At init the LM should be ~uniform: loss ≈ log(vocab)."""
+    import optax
+
+    model, params = _init(tiny_model_cfg)
+    rng = np.random.default_rng(0)
+    x = jnp.array(rng.integers(0, tiny_model_cfg.vocab_size, size=(4, 32)), dtype=jnp.int32)
+    logits = model.apply({"params": params}, x[:, :-1], train=False)
+    loss = optax.softmax_cross_entropy_with_integer_labels(logits, x[:, 1:]).mean()
+    # lecun-normal lm_head at d_model=64 gives ~unit-variance logits, so
+    # expected loss sits slightly above ln(V).
+    assert abs(float(loss) - np.log(tiny_model_cfg.vocab_size)) < 1.0
+
+
+def test_dropout_needs_rng_and_changes_output(tiny_model_cfg):
+    from dataclasses import replace
+
+    cfg = replace(tiny_model_cfg, dropout=0.5)
+    model, params = _init(cfg)
+    x = jnp.zeros((2, 16), dtype=jnp.int32)
+    a = model.apply({"params": params}, x, train=True, rngs={"dropout": jax.random.PRNGKey(1)})
+    b = model.apply({"params": params}, x, train=True, rngs={"dropout": jax.random.PRNGKey(2)})
+    assert not np.allclose(np.asarray(a), np.asarray(b))

@@ -1,0 +1,72 @@
+"""Sharding rule table: exhaustive coverage + expected TP/DP specs.
+
+The TPU-native analog of eyeballing the reference's string-matching rules
+(`/root/reference/parallel/sharding.py:17-62`) — here the table is data and
+every param path must be covered or param_logical_axes raises.
+"""
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from dtc_tpu.models.gpt import GPT
+from dtc_tpu.parallel.mesh import build_mesh
+from dtc_tpu.parallel.sharding import (
+    DEFAULT_RULES,
+    batch_spec,
+    logical_to_spec,
+    param_logical_axes,
+    param_specs,
+    shard_params,
+)
+
+
+def _params(cfg):
+    model = GPT(cfg)
+    x = jnp.ones((1, cfg.max_seq_len), dtype=jnp.int32)
+    return model.init({"params": jax.random.PRNGKey(0)}, x, train=False)["params"]
+
+
+def test_table_covers_every_param(tiny_model_cfg):
+    params = _params(tiny_model_cfg)
+    axes_tree = param_logical_axes(params)  # raises if any path is missing
+    assert len(jax.tree.leaves(params)) == len(
+        jax.tree.leaves(axes_tree, is_leaf=lambda x: isinstance(x, tuple))
+    )
+
+
+def test_tp_specs_megatron_layout(tiny_model_cfg):
+    specs = param_specs(_params(tiny_model_cfg), DEFAULT_RULES)
+    blocks = specs["stage"]["blocks"]["Block_0"]
+    # column-parallel qkv + fc1; row-parallel out_proj + fc2
+    assert blocks["attn"]["q_proj"]["kernel"] == P(None, None, "model")
+    assert blocks["attn"]["out_proj"]["kernel"] == P(None, "model", None)
+    assert blocks["mlp"]["fc1"]["kernel"] == P(None, None, "model")
+    assert blocks["mlp"]["fc2"]["kernel"] == P(None, "model", None)
+    # vocab-parallel lm_head; replicated embeddings and norms
+    assert specs["head"]["lm_head"]["kernel"] == P(None, "model")
+    assert specs["embed"]["wte"]["embedding"] == P(None, None)
+    assert blocks["ln_1"]["scale"] == P(None, None)
+
+
+def test_batch_spec():
+    assert batch_spec(DEFAULT_RULES) == P("data", None)
+
+
+def test_logical_to_spec_unknown_axis_raises():
+    import pytest
+
+    with pytest.raises(KeyError):
+        logical_to_spec(("nonsense",), DEFAULT_RULES)
+
+
+def test_shard_params_places_on_mesh(tiny_model_cfg):
+    mesh = build_mesh((1, 2, 4))
+    params = _params(tiny_model_cfg)
+    sharded, specs = shard_params(params, mesh)
+    k = sharded["stage"]["blocks"]["Block_0"]["mlp"]["fc1"]["kernel"]
+    # fc1 kernel (L, d_model, d_ff) sharded 4-way over d_ff
+    assert k.sharding.spec == P(None, None, "model")
+    n_l, d, f = k.shape
+    shard_shape = k.sharding.shard_shape(k.shape)
+    assert shard_shape == (n_l, d, f // 4)
