@@ -62,8 +62,13 @@ def causal_attention(
 ) -> jax.Array:
     """Dispatch causal self-attention over ``(B, T, H, D)`` tensors."""
     if impl == "auto":
+        from dtc_tpu.ops import flash_attention
+
         t, d = q.shape[1], q.shape[3]
-        if _on_tpu() and t >= 256 and t % 128 == 0 and d % 128 == 0:
+        # head_dim is zero-padded to the lane width inside the kernel, so the
+        # flagship shape (head_dim=32, T=512) qualifies; only the sequence
+        # tiling has to divide.
+        if _on_tpu() and t >= 256 and flash_attention.supports(t, d, block_q, block_kv):
             impl = "flash"
         else:
             impl = "dense"

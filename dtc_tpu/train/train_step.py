@@ -12,6 +12,7 @@ selected by :func:`create_train_step` when the mesh's ``pipe`` axis is > 1.
 
 from __future__ import annotations
 
+import functools
 from typing import Any, Callable, Sequence
 
 import jax
@@ -68,7 +69,10 @@ def create_gspmd_train_step(
     the arguments, batch sharding from the logical ("batch","seq") constraint.
     """
 
-    @jax.jit
+    # Donating the state lets XLA update params/opt-state in place instead of
+    # allocating a second ~1.1 GB copy (fp32 master params + two AdamW moments)
+    # and copying every step.
+    @functools.partial(jax.jit, donate_argnums=(0,))
     def train_step(state: TrainState, batch: Batch, rng: jax.Array):
         x = nn.with_logical_constraint(batch.x, ("batch", "seq"))
         y = nn.with_logical_constraint(batch.y, ("batch", "seq"))

@@ -43,7 +43,9 @@ def gpt_step_flops(cfg: ModelConfig, batch: int, seq_len: int) -> float:
     """
     n = param_count(cfg)
     # wte/wpe gathers are not matmuls; lm_head IS a matmul and is counted.
-    n_matmul = n - cfg.vocab_size * cfg.d_model - cfg.max_seq_len * cfg.d_model
+    # Subtract on the padded-vocab basis param_count uses (round-1 ADVICE:
+    # mixing bases counted the pad rows as matmul FLOPs).
+    n_matmul = n - cfg.padded_vocab_size * cfg.d_model - cfg.max_seq_len * cfg.d_model
     tokens = batch * seq_len
     dense = 6.0 * n_matmul * tokens
     attn = 12.0 * cfg.n_layers * batch * (seq_len**2) * cfg.d_model / 2.0

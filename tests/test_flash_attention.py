@@ -1,0 +1,83 @@
+"""Flash-attention parity vs the dense reference implementation.
+
+Runs the Pallas kernels in interpreter mode on CPU (conftest forces the CPU
+platform); the same code compiles via Mosaic on TPU. Parity target:
+``dense_causal_attention`` (ops/attention.py), which itself reproduces the
+reference semantics (`/root/reference/model/CausalSelfAttention.py:34-42`).
+"""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from dtc_tpu.ops.attention import causal_attention, dense_causal_attention
+from dtc_tpu.ops.flash_attention import flash_causal_attention, supports
+
+
+def _qkv(key, b, t, h, d, dtype=jnp.float32):
+    kq, kk, kv = jax.random.split(key, 3)
+    shape = (b, t, h, d)
+    return (
+        jax.random.normal(kq, shape, dtype),
+        jax.random.normal(kk, shape, dtype),
+        jax.random.normal(kv, shape, dtype),
+    )
+
+
+# (T, D, block_q, block_kv): flagship-like padded head_dim, lane-sized head
+# dim, and multi-block tilings exercising the online-softmax accumulation.
+SHAPES = [
+    (256, 32, 256, 256),    # single block, padded head_dim (flagship-like)
+    (256, 128, 128, 128),   # 2x2 blocks, lane-width head_dim
+    (512, 32, 128, 128),    # 4x4 blocks, padded head_dim (flagship tiling)
+    (512, 64, 256, 128),    # rectangular blocks
+]
+
+
+@pytest.mark.parametrize("t,d,bq,bkv", SHAPES)
+def test_forward_parity(t, d, bq, bkv):
+    q, k, v = _qkv(jax.random.PRNGKey(0), 2, t, 3, d)
+    ref = dense_causal_attention(q, k, v)
+    got = flash_causal_attention(q, k, v, block_q=bq, block_kv=bkv)
+    assert got.shape == ref.shape and got.dtype == ref.dtype
+    assert jnp.max(jnp.abs(got - ref)) < 2e-5
+
+
+@pytest.mark.parametrize("t,d,bq,bkv", SHAPES)
+def test_grad_parity(t, d, bq, bkv):
+    q, k, v = _qkv(jax.random.PRNGKey(1), 2, t, 2, d)
+
+    def loss_dense(q, k, v):
+        return jnp.sum(dense_causal_attention(q, k, v) ** 2)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_causal_attention(q, k, v, block_q=bq, block_kv=bkv) ** 2)
+
+    g_ref = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    g_got = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    for name, a, b in zip("qkv", g_ref, g_got):
+        err = jnp.max(jnp.abs(a - b)) / (jnp.max(jnp.abs(a)) + 1e-8)
+        assert err < 2e-4, f"d{name} relative error {err}"
+
+
+def test_bf16_forward():
+    q, k, v = _qkv(jax.random.PRNGKey(2), 1, 256, 2, 32, jnp.bfloat16)
+    ref = dense_causal_attention(q, k, v)
+    got = flash_causal_attention(q, k, v, block_q=128, block_kv=128)
+    assert got.dtype == jnp.bfloat16
+    # bf16 has ~3 decimal digits; compare in fp32 with a loose tolerance.
+    assert jnp.max(jnp.abs(got.astype(jnp.float32) - ref.astype(jnp.float32))) < 0.05
+
+
+def test_supports_flagship():
+    # The flagship (head_dim=32, T=512) must qualify — VERDICT round 1 flagged
+    # the old d % 128 == 0 heuristic as unreachable for it.
+    assert supports(512, 32, 512, 512)
+    assert supports(512, 32, 128, 128)
+    assert not supports(100, 32, 128, 128)  # T not tileable
+
+
+def test_dispatch_unknown_impl():
+    q, k, v = _qkv(jax.random.PRNGKey(3), 1, 32, 2, 16)
+    with pytest.raises(ValueError):
+        causal_attention(q, k, v, impl="nope")
