@@ -20,14 +20,16 @@ def synthetic_batch_iterator(
     seq_len: int,
     vocab_size: int,
     seed: int = 0,
+    start: int = 0,
 ) -> Iterator[np.ndarray]:
     """Yield deterministic (batch_size, seq_len) int32 batches.
 
     Batch ``i`` for a given (seed, shape, vocab) is identical across runs,
     processes, and mesh shapes — the property the cross-strategy parity
-    tests rely on.
+    tests rely on. ``start`` begins the stream at batch index ``start``
+    in O(1) (used by checkpoint resume to skip consumed batches).
     """
-    i = 0
+    i = start
     while True:
         rng = np.random.default_rng(np.random.SeedSequence([seed, i]))
         # Zipf-distributed unigrams, clipped into vocab.

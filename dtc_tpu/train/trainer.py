@@ -59,9 +59,13 @@ class TrainResult:
 
 
 def make_host_iterator(
-    train_cfg: TrainConfig, model_cfg: ModelConfig
+    train_cfg: TrainConfig, model_cfg: ModelConfig, skip_batches: int = 0
 ) -> Iterator[np.ndarray]:
-    """(batch, seq_len+1) token batches; per-process share in multi-host runs."""
+    """(batch, seq_len+1) token batches; per-process share in multi-host runs.
+
+    ``skip_batches`` positions the stream past already-consumed batches on
+    resume — O(1) for the seeded synthetic stream, a drain loop for
+    streaming datasets."""
     seq = model_cfg.max_seq_len + 1
     batch = train_cfg.batch
     if jax.process_count() > 1:
@@ -70,10 +74,15 @@ def make_host_iterator(
     if train_cfg.dataset == "synthetic":
         # Offset multi-host streams so processes contribute distinct data.
         seed = train_cfg.seed * 1000 + jax.process_index()
-        return synthetic_batch_iterator(batch, seq, model_cfg.vocab_size, seed=seed)
+        return synthetic_batch_iterator(
+            batch, seq, model_cfg.vocab_size, seed=seed, start=skip_batches
+        )
     from dtc_tpu.data.fineweb import fineweb_batch_iterator
 
-    return fineweb_batch_iterator(batch, seq)
+    it = fineweb_batch_iterator(batch, seq)
+    for _ in range(skip_batches):
+        next(it)
+    return it
 
 
 def init_state(
@@ -148,7 +157,10 @@ def train(
             ckpt = CheckpointManager(ckpt_dir)
             if train_cfg.resume and ckpt.latest_step() is not None:
                 state = ckpt.restore(state)
-                start_step = int(state.step)
+                # Checkpoint labels are LOOP steps. state.step also counts
+                # warmup updates, so it reads warmup_steps ahead — using it
+                # here would skip real work on resume.
+                start_step = ckpt.latest_step()
                 if lead:
                     print(f"[dtc_tpu] resumed from checkpoint step {start_step}")
 
@@ -156,11 +168,25 @@ def train(
             mesh, model=model, num_microbatches=train_cfg.pp_microbatches, rules=rules
         )
 
-        host_it = host_iterator or make_host_iterator(train_cfg, model_cfg)
+        # Resume parity: the interrupted run consumed warmup_steps +
+        # start_step batches before reaching step start_step+1 — position the
+        # stream there (warmup itself is skipped on resume: running it
+        # against the restored state would advance it past the checkpointed
+        # step).
+        skip = train_cfg.warmup_steps + start_step if start_step > 0 else 0
+        if host_iterator is not None:
+            host_it = host_iterator
+            for _ in range(skip):
+                next(host_it)
+        else:
+            host_it = make_host_iterator(train_cfg, model_cfg, skip_batches=skip)
         data_it = ShardedPrefetchIterator(
             host_it, mesh, batch_spec(rules), queue_size=train_cfg.prefetch
         )
-        key = jax.random.PRNGKey(train_cfg.seed)
+        # Per-step dropout keys are fold_in(key, step) — a resumed run
+        # replays the identical RNG stream from any step, unlike a split
+        # chain whose position would restart at 0 (round-1 ADVICE).
+        key = jax.random.key(train_cfg.seed, impl=train_cfg.prng_impl)
         profiler = StepWindowProfiler(
             train_cfg.profile_start,
             train_cfg.profile_stop,
@@ -175,16 +201,36 @@ def train(
         )
 
         # ------ warmup (untimed, excluded from measurement; ref uses 5) ------
-        if lead and train_cfg.warmup_steps:
+        warmup_steps = 0 if start_step > 0 else train_cfg.warmup_steps
+        if lead and warmup_steps:
             print("Warmup")
-        for _ in range(train_cfg.warmup_steps):
+        warm_key = jax.random.fold_in(key, 2**31 - 1)  # stream disjoint from steps
+        for i in range(warmup_steps):
             x, y = next(data_it)
-            key, subkey = jax.random.split(key)
-            state, loss = train_step(state, Batch(x=x, y=y), subkey)
-        if train_cfg.warmup_steps:
+            state, loss = train_step(state, Batch(x=x, y=y), jax.random.fold_in(warm_key, i))
+        if warmup_steps:
             # Sync via value fetch — reliable even on remote-execution
             # platforms where block_until_ready returns early.
             jax.device_get(loss)
+
+        if start_step > 0:
+            # Warmup is skipped on resume, so the first timed step would pay
+            # the full XLA compile and corrupt the first log window's
+            # timings. Compile now by running the step once on a throwaway
+            # COPY of the restored state with a dummy batch — same
+            # shapes/shardings hit the same executable, and neither the real
+            # state nor the data/RNG streams are touched.
+            dummy = jax.device_put(
+                np.zeros((train_cfg.batch, model_cfg.max_seq_len), np.int32),
+                NamedSharding(mesh, batch_spec(rules)),
+            )
+            state_copy = jax.tree.map(
+                lambda v: jnp.copy(v) if isinstance(v, jax.Array) else v, state
+            )
+            _, compile_loss = train_step(
+                state_copy, Batch(x=dummy, y=dummy), jax.random.fold_in(key, 0)
+            )
+            jax.device_get(compile_loss)
 
         # ------ timed loop ------
         if lead:
@@ -200,8 +246,7 @@ def train(
         for step in range(start_step + 1, train_cfg.steps + 1):
             profiler.step(step)
             x, y = next(data_it)
-            key, subkey = jax.random.split(key)
-            state, loss = train_step(state, Batch(x=x, y=y), subkey)
+            state, loss = train_step(state, Batch(x=x, y=y), jax.random.fold_in(key, step))
             device_losses.append(loss)
             if train_cfg.sync_every_step:
                 jax.block_until_ready(loss)
