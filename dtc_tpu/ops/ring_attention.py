@@ -1,0 +1,133 @@
+"""Ring attention — sequence-parallel causal attention over the mesh.
+
+Long-context capability the reference entirely lacks (its attention
+materialises the full (B,H,T,T) score tensor and caps max_seq_len at 512,
+`/root/reference/model/CausalSelfAttention.py:34-42`). Here the SEQUENCE
+axis of q/k/v is sharded over the mesh's ``model`` axis (RING_RULES in
+parallel/sharding.py): each device keeps its query block resident while
+key/value blocks rotate around the ring via ``lax.ppermute`` — the same
+ICI-neighbor collective machinery as the pipeline (parallel/pipeline.py) —
+and a running online softmax merges each block's contribution. Per-device
+score memory is O(T_local²) and activation memory O(T/ring), so max
+sequence length scales linearly with ring size.
+
+Structure notes:
+
+- ``jax.shard_map`` manual over ``model`` ONLY; ``data`` (and ``pipe``)
+  stay GSPMD-auto, so ring attention composes with DP for free.
+- Uniform collective schedule: every device executes the same m ring steps
+  (blocks entirely in the causal future contribute zeros via the mask)
+  — no data-dependent branching, mirroring the pipeline's design.
+- Backward is plain autodiff: ``ppermute`` transposes to the inverse
+  rotation, so gradient KV blocks counter-rotate automatically — no manual
+  backward schedule.
+- Numerics match ``dense_causal_attention``: fp32 scores/softmax, -1e9
+  additive mask, accumulate in fp32, cast out to the input dtype.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+NEG_INF = -1e9
+
+
+def _ambient_mesh():
+    """The mesh installed by the trainer's ``with mesh:`` context."""
+    from jax._src.mesh import thread_resources
+
+    mesh = thread_resources.env.physical_mesh
+    if mesh.empty:
+        raise RuntimeError(
+            "ring attention needs an active mesh context (`with mesh:`); "
+            "none is installed"
+        )
+    return mesh
+
+
+def ring_causal_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    axis_name: str = "model",
+    mesh=None,
+) -> jax.Array:
+    """Causal attention over ``(B, T, H, D)`` with T sharded over ``axis_name``.
+
+    Call under an active mesh; T must divide evenly by the ring size.
+    """
+    from jax._src.core import trace_state_clean
+
+    if trace_state_clean():
+        # Eager call — flax ``model.init`` runs the forward outside jit, and
+        # partial-manual shard_map only exists under a jit trace. The dense
+        # path is numerically identical (init only consumes shapes).
+        from dtc_tpu.ops.attention import dense_causal_attention
+
+        return dense_causal_attention(q, k, v)
+
+    mesh = mesh if mesh is not None else _ambient_mesh()
+    ring = mesh.shape[axis_name]
+    b, t, h, d = q.shape
+    if t % ring != 0:
+        raise ValueError(f"seq len {t} not divisible by ring size {ring}")
+    scale = d ** -0.5
+
+    def local_ring(q_blk, k_blk, v_blk):
+        # Shapes here are (B, T/ring, H, D); batch stays GSPMD-auto.
+        idx = lax.axis_index(axis_name)
+        t_loc = q_blk.shape[1]
+        perm = [(i, (i + 1) % ring) for i in range(ring)]
+        row = jax.lax.broadcasted_iota(jnp.int32, (t_loc, t_loc), 0)
+        col = jax.lax.broadcasted_iota(jnp.int32, (t_loc, t_loc), 1)
+
+        def step(carry, s):
+            k_cur, v_cur, m_run, l_run, acc = carry
+            src = (idx - s) % ring  # global block id the rotating KV holds
+            scores = jnp.einsum(
+                "bthd,bshd->bhts", q_blk, k_cur,
+                preferred_element_type=jnp.float32,
+            ) * scale
+            # Causal mask on GLOBAL positions: query idx*t_loc+row vs key
+            # src*t_loc+col. Blocks fully in the future mask to all -inf and
+            # contribute exp(-1e9 - m_run) = 0 (the first step, src == idx,
+            # is the diagonal block, so m_run is real from step 0 on).
+            mask = (src * t_loc + col) <= (idx * t_loc + row)
+            scores = jnp.where(mask[None, None], scores, NEG_INF)
+
+            m_new = jnp.maximum(m_run, jnp.max(scores, axis=-1))
+            alpha = jnp.exp(m_run - m_new)                   # (B,H,Tl)
+            p = jnp.exp(scores - m_new[..., None])           # (B,H,Tl,Sl)
+            l_new = alpha * l_run + jnp.sum(p, axis=-1)
+            acc = acc * alpha.transpose(0, 2, 1)[..., None] + jnp.einsum(
+                "bhts,bshd->bthd", p.astype(v_cur.dtype), v_cur,
+                preferred_element_type=jnp.float32,
+            )
+            # Rotate KV one hop; uniform schedule keeps the last rotation
+            # (KV returns home) rather than branching on the step index.
+            k_next = lax.ppermute(k_cur, axis_name, perm)
+            v_next = lax.ppermute(v_cur, axis_name, perm)
+            return (k_next, v_next, m_new, l_new, acc), None
+
+        m0 = jnp.full((b, h, t_loc), NEG_INF, jnp.float32)
+        l0 = jnp.zeros((b, h, t_loc), jnp.float32)
+        acc0 = jnp.zeros((b, t_loc, h, d), jnp.float32)
+        (_, _, _, l_fin, acc), _ = lax.scan(
+            step, (k_blk, v_blk, m0, l0, acc0), jnp.arange(ring)
+        )
+        out = acc / l_fin.transpose(0, 2, 1)[..., None]
+        return out.astype(q_blk.dtype)
+
+    spec = P(None, axis_name, None, None)
+    return jax.shard_map(
+        local_ring,
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        axis_names={axis_name},
+        check_vma=False,
+    )(q, k, v)

@@ -52,9 +52,15 @@ DEFAULT_RULES: tuple[tuple[str, str | None], ...] = (
 )
 
 #: Rules for ring-attention / sequence parallelism: the sequence axis of
-#: activations is sharded over "model" and KV blocks rotate via ppermute.
+#: activations is sharded over "model" and KV blocks rotate via ppermute
+#: (ops/ring_attention.py). The "model" mesh axis then carries SEQUENCE
+#: parallelism, so the Megatron TP mappings (heads/qkv/mlp/vocab_out) must
+#: come off it — one mesh axis cannot shard two logical axes of one tensor.
 RING_RULES: tuple[tuple[str, str | None], ...] = tuple(
-    (name, "model") if name == "seq" else (name, axis) for name, axis in DEFAULT_RULES
+    (name, "model") if name == "seq"
+    else (name, None) if name in ("heads", "qkv", "mlp", "vocab_out")
+    else (name, axis)
+    for name, axis in DEFAULT_RULES
 )
 
 

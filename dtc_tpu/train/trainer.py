@@ -102,9 +102,12 @@ def init_state(
     """
     dummy = jnp.ones((1, model_cfg.max_seq_len), dtype=jnp.int32)
     init_rng = jax.random.PRNGKey(train_cfg.seed)
-    params = model.init({"params": init_rng, "dropout": init_rng}, dummy, train=False)[
-        "params"
-    ]
+    # Init under jit: ops that build partial-manual shard_map regions (ring
+    # attention) only exist under a jit trace, and jit also avoids
+    # materialising throwaway init activations eagerly.
+    params = jax.jit(
+        lambda rng, x: model.init({"params": rng, "dropout": rng}, x, train=False)
+    )(init_rng, dummy)["params"]
     pp = mesh.shape.get("pipe", 1) > 1
     if pp:
         params = pp_stack_params(params, mesh.shape["pipe"])
@@ -133,6 +136,13 @@ def train(
     maybe_initialize_distributed(train_cfg.multihost)
     num_devices = jax.device_count()
     mesh = mesh_from_config(train_cfg.parallel, train_cfg.mesh)
+    if model_cfg.attention == "ring" and rules is DEFAULT_RULES:
+        # Ring attention repurposes the "model" mesh axis for sequence
+        # parallelism; swap in the rule table that shards seq instead of
+        # the Megatron TP axes (see parallel/sharding.py RING_RULES).
+        from dtc_tpu.parallel.sharding import RING_RULES
+
+        rules = RING_RULES
     lead = is_lead_process()
     if lead:
         print(

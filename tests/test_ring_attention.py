@@ -1,0 +1,88 @@
+"""Ring attention (sequence parallelism) parity on the virtual device mesh.
+
+VERDICT round 1 item #6: RING_RULES existed and README advertised ring
+attention, but the op was missing. These tests assert the real thing: the
+sequence axis sharded over the "model" mesh axis, KV rotating via ppermute,
+must reproduce dense causal attention (forward AND gradients) and train
+end-to-end through the trainer with loss parity against a dense run.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dtc_tpu.config.schema import MeshConfig
+from dtc_tpu.ops.attention import causal_attention, dense_causal_attention
+from dtc_tpu.ops.ring_attention import ring_causal_attention
+from dtc_tpu.parallel.mesh import mesh_from_config
+from dtc_tpu.parallel.sharding import RING_RULES
+from dtc_tpu.train.trainer import train
+
+
+def _qkv(key, b, t, h, d):
+    ks = jax.random.split(key, 3)
+    return tuple(jax.random.normal(k, (b, t, h, d), jnp.float32) for k in ks)
+
+
+@pytest.mark.parametrize("ring", [2, 4, 8])
+def test_forward_parity(ring):
+    mesh = mesh_from_config("3d", MeshConfig(pipe=1, data=8 // ring, model=ring))
+    q, k, v = _qkv(jax.random.PRNGKey(0), 2, 64, 2, 16)
+    ref = dense_causal_attention(q, k, v)
+    with mesh:
+        got = jax.jit(lambda q, k, v: ring_causal_attention(q, k, v))(q, k, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=2e-5)
+
+
+def test_grad_parity():
+    mesh = mesh_from_config("3d", MeshConfig(pipe=1, data=2, model=4))
+    q, k, v = _qkv(jax.random.PRNGKey(1), 2, 64, 2, 16)
+
+    g_ref = jax.grad(lambda q, k, v: jnp.sum(dense_causal_attention(q, k, v) ** 2),
+                     argnums=(0, 1, 2))(q, k, v)
+    with mesh:
+        g_got = jax.jit(jax.grad(
+            lambda q, k, v: jnp.sum(ring_causal_attention(q, k, v) ** 2),
+            argnums=(0, 1, 2),
+        ))(q, k, v)
+    for name, a, b in zip("qkv", g_ref, g_got):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a), atol=2e-4,
+                                   err_msg=f"d{name}")
+
+
+def test_dispatch_ring():
+    mesh = mesh_from_config("3d", MeshConfig(pipe=1, data=4, model=2))
+    q, k, v = _qkv(jax.random.PRNGKey(2), 1, 32, 2, 16)
+    with mesh:
+        # partial-manual shard_map requires a jit context — matching real
+        # usage (the model always runs under the jitted train step).
+        got = jax.jit(lambda q, k, v: causal_attention(q, k, v, impl="ring"))(q, k, v)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(dense_causal_attention(q, k, v)), atol=2e-5
+    )
+
+
+def test_ring_seq_not_divisible_raises():
+    mesh = mesh_from_config("3d", MeshConfig(pipe=1, data=1, model=8))
+    q, k, v = _qkv(jax.random.PRNGKey(3), 1, 36, 2, 16)  # 36 % 8 != 0
+    with mesh, pytest.raises(ValueError, match="not divisible"):
+        jax.jit(lambda q, k, v: ring_causal_attention(q, k, v))(q, k, v)
+
+
+def test_train_ring_matches_dense(train_cfg_factory, tiny_model_cfg, opt_cfg):
+    """End-to-end: 3 steps with ring attention (seq sharded over model=4,
+    composed with data=2) must match a dense DP run — same seed, dropout 0."""
+    dense_cfg = train_cfg_factory("dp", steps=3, log_every=1)
+    dense = train(dense_cfg, tiny_model_cfg, opt_cfg)
+
+    ring_model = dataclasses.replace(tiny_model_cfg, attention="ring")
+    ring_cfg = train_cfg_factory(
+        "3d", steps=3, log_every=1, mesh=MeshConfig(pipe=1, data=2, model=4)
+    )
+    ring = train(ring_cfg, ring_model, opt_cfg)
+    np.testing.assert_allclose(ring.losses, dense.losses, rtol=2e-4)
+    # RING_RULES actually engaged (trainer swaps the table itself).
+    assert RING_RULES[[r[0] for r in RING_RULES].index("seq")][1] == "model"
