@@ -124,9 +124,15 @@ class TrainConfig:
     dataset: str = "fineweb"     # fineweb | synthetic
     warmup_steps: int = 5        # untimed warmup steps (reference uses 5)
     prefetch: int = 2            # host->device prefetch depth; 0 = synchronous
-    sync_every_step: bool = False  # block on loss each step (reference behavior)
+    # Per-step device sync before stamping elapsed_time. None = auto: ON
+    # whenever CSV logging is on (so every logged row is a real synced step
+    # time, comparable to the reference's /root/reference/train/train.py:82),
+    # OFF otherwise (max throughput; only log-boundary windows are synced).
+    sync_every_step: bool | None = None
     checkpoint_every: int = 0    # 0 = disabled
     checkpoint_dir: str = ""     # default: <output_dir>/checkpoints
+    eval_every: int = 0          # periodic held-out eval loss; 0 = disabled
+    eval_batches: int = 8        # batches per eval pass
     resume: bool = True          # resume from latest checkpoint if present
     profile_start: int = 0       # capture jax.profiler trace [start, stop)
     profile_stop: int = 0

@@ -8,11 +8,15 @@ boundary-free concatenation — but the packing is delegated to
 background thread so the (network + CPU)-bound work overlaps device compute
 instead of sitting on the training critical path (the reference tokenizes
 synchronously inside the step loop, SURVEY.md §3.4).
+
+Multi-host: documents are striped round-robin by ``process_index`` /
+``process_count`` so every pod host tokenizes a DISJOINT slice of the
+stream (the reference is single-process and has no notion of this).
 """
 
 from __future__ import annotations
 
-from typing import Iterator
+from typing import Iterable, Iterator
 
 import numpy as np
 
@@ -20,11 +24,23 @@ from dtc_tpu.data.packing import pack_token_stream
 from dtc_tpu.data.tokenizer import get_tokenizer
 
 
-def _document_tokens(tokenizer) -> Iterator[list[int]]:
+def stride_documents(
+    documents: Iterable, process_index: int, process_count: int
+) -> Iterator:
+    """Round-robin stripe of a document stream: process p sees items
+    p, p+N, p+2N, … — disjoint across processes, union = full stream."""
+    for i, item in enumerate(documents):
+        if i % process_count == process_index:
+            yield item
+
+
+def _document_tokens(
+    tokenizer, process_index: int, process_count: int
+) -> Iterator[list[int]]:
     from datasets import load_dataset  # network-bound import kept local
 
     ds = load_dataset("HuggingFaceFW/fineweb-edu", split="train", streaming=True)
-    for item in ds:
+    for item in stride_documents(ds, process_index, process_count):
         yield tokenizer.encode(item["text"])
 
 
@@ -32,7 +48,20 @@ def fineweb_batch_iterator(
     batch_size: int,
     seq_len: int,
     tokenizer=None,
+    *,
+    process_index: int = 0,
+    process_count: int = 1,
+    documents: Iterator[list[int]] | None = None,
 ) -> Iterator[np.ndarray]:
-    """Yield (batch_size, seq_len) int32 batches from streamed FineWeb-Edu."""
-    tokenizer = tokenizer or get_tokenizer()
-    yield from pack_token_stream(_document_tokens(tokenizer), batch_size, seq_len)
+    """Yield (batch_size, seq_len) int32 batches from streamed FineWeb-Edu.
+
+    ``documents`` injects a pre-tokenized document stream (tests / offline);
+    when given it is ALSO striped by process, so the multi-host contract is
+    testable without the network.
+    """
+    if documents is not None:
+        docs = stride_documents(documents, process_index, process_count)
+    else:
+        tokenizer = tokenizer or get_tokenizer()
+        docs = _document_tokens(tokenizer, process_index, process_count)
+    yield from pack_token_stream(docs, batch_size, seq_len)

@@ -31,6 +31,15 @@ def put_batch(x: np.ndarray, mesh: Mesh, spec: P) -> jax.Array:
     return jax.device_put(x, sharding)
 
 
+def split_put(batch: np.ndarray, mesh: Mesh, spec: P) -> tuple[jax.Array, jax.Array]:
+    """Split a (B, T+1) token batch into next-token (x, y) device arrays
+    (x = [:, :-1], y = [:, 1:], as the reference does at
+    /root/reference/train/train.py:76-77) placed with ``spec``."""
+    x = put_batch(np.ascontiguousarray(batch[:, :-1]), mesh, spec)
+    y = put_batch(np.ascontiguousarray(batch[:, 1:]), mesh, spec)
+    return x, y
+
+
 class ShardedPrefetchIterator:
     """Wrap a host batch iterator; yield (x, y) device arrays.
 
@@ -59,9 +68,7 @@ class ShardedPrefetchIterator:
             self._thread.start()
 
     def _split_put(self, batch: np.ndarray):
-        x = put_batch(np.ascontiguousarray(batch[:, :-1]), self._mesh, self._spec)
-        y = put_batch(np.ascontiguousarray(batch[:, 1:]), self._mesh, self._spec)
-        return x, y
+        return split_put(batch, self._mesh, self._spec)
 
     def _worker(self):
         try:

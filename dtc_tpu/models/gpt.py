@@ -143,7 +143,9 @@ class GPTEmbed(nn.Module):
     lookup: str = "gather"
 
     @nn.compact
-    def __call__(self, x: jax.Array, *, train: bool = True) -> jax.Array:
+    def __call__(
+        self, x: jax.Array, *, train: bool = True, pos_offset: int | jax.Array = 0
+    ) -> jax.Array:
         cfg = self.cfg
         pdtype = _dtype(cfg.param_dtype)
         _, t = x.shape
@@ -153,9 +155,14 @@ class GPTEmbed(nn.Module):
             tok = onehot @ wte.embedding.astype(_dtype(cfg.compute_dtype))
         else:
             tok = wte(x)
-        # Positions are a static prefix: slice the table instead of gathering.
+        # Positions are a contiguous slice of the table, not a gather.
+        # ``pos_offset`` (possibly traced, e.g. stage_id * chunk in the
+        # pipeline's seq-chunked embed) says where the slice starts.
         wpe = nn.Embed(cfg.max_seq_len, cfg.d_model, name="wpe", param_dtype=pdtype)
-        pos = wpe.embedding[:t][None, :, :]
+        if isinstance(pos_offset, int) and pos_offset == 0:
+            pos = wpe.embedding[:t][None, :, :]
+        else:
+            pos = jax.lax.dynamic_slice_in_dim(wpe.embedding, pos_offset, t, axis=0)[None]
         h = (tok + pos).astype(_dtype(cfg.compute_dtype))
         h = nn.Dropout(cfg.dropout, deterministic=not train)(h)
         return nn.with_logical_constraint(h, ("batch", "seq", "embed"))

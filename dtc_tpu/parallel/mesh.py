@@ -39,13 +39,30 @@ AXIS_NAMES = ("pipe", "data", "model")
 PIPE, DATA, MODEL = AXIS_NAMES
 
 
-def resolve_mesh_shape(parallel: str, num_devices: int, mesh: MeshConfig) -> tuple[int, int, int]:
+def resolve_mesh_shape(
+    parallel: str,
+    num_devices: int,
+    mesh: MeshConfig,
+    n_layers: int | None = None,
+    pipe_dcn: int = 1,
+) -> tuple[int, int, int]:
     """Resolve ``(pipe, data, model)`` ICI axis sizes.
 
     Zero entries in ``mesh`` are auto-filled from the strategy: the strategy's
     own axis absorbs all devices not claimed by explicit entries. Validates
     that the product covers every device (a partially used slice wastes
     chips silently otherwise).
+
+    ``n_layers`` makes pipeline resolution layer-aware: an auto-filled
+    ``pipe`` axis is capped at the largest divisor of the device budget that
+    also divides ``n_layers`` (leftover devices become data parallelism), and
+    an explicit ``pipe`` that does not divide ``n_layers`` is a ValueError
+    here instead of an error deep in the pipeline step. The reference
+    instead silently truncates the model to ``n_layers // num_devices``
+    stages' worth of layers (`/root/reference/train/train.py:118`).
+    ``pipe_dcn`` is the DCN factor of the pipe axis: the stage count the
+    pipeline actually sees is ``pipe * pipe_dcn``, so divisibility is
+    checked against the total, not just the ICI part.
     """
     sizes = {PIPE: mesh.pipe, DATA: mesh.data, MODEL: mesh.model}
     primary = {"dp": DATA, "tp": MODEL, "pp": PIPE, "none": DATA, "3d": None}[parallel]
@@ -65,6 +82,27 @@ def resolve_mesh_shape(parallel: str, num_devices: int, mesh: MeshConfig) -> tup
                 )
             sizes = {k: explicit.get(k, 1) for k in sizes}
             sizes[primary] = num_devices // known
+            if primary == PIPE and n_layers is not None:
+                # Largest stage count that divides both the device budget
+                # and the layer count; surplus devices do data parallelism.
+                pipe = sizes[PIPE]
+                while n_layers % (pipe * pipe_dcn) != 0 or sizes[PIPE] % pipe != 0:
+                    pipe -= 1
+                    if pipe == 0:
+                        raise ValueError(
+                            f"no pipe size <= {sizes[PIPE]} satisfies "
+                            f"n_layers={n_layers} % (pipe * dcn_pipe={pipe_dcn}) == 0"
+                        )
+                sizes[DATA] = sizes[DATA] * (sizes[PIPE] // pipe)
+                sizes[PIPE] = pipe
+
+    total_pipe = sizes[PIPE] * pipe_dcn
+    if n_layers is not None and total_pipe > 1 and n_layers % total_pipe != 0:
+        raise ValueError(
+            f"pipe={sizes[PIPE]} x dcn_pipe={pipe_dcn} = {total_pipe} stages do "
+            f"not divide n_layers={n_layers}; set mesh.pipe/dcn_pipe so their "
+            "product divides the layer count"
+        )
 
     shape = (sizes[PIPE], sizes[DATA], sizes[MODEL])
     if math.prod(shape) != num_devices:
@@ -90,9 +128,23 @@ def build_mesh(
     """
     devices = list(devices if devices is not None else jax.devices())
     if dcn_shape is not None and any(d > 1 for d in dcn_shape):
-        device_array = mesh_utils.create_hybrid_device_mesh(
-            shape, dcn_shape, devices=devices, allow_split_physical_axes=True
-        )
+        try:
+            device_array = mesh_utils.create_hybrid_device_mesh(
+                shape, dcn_shape, devices=devices, allow_split_physical_axes=True
+            )
+        except ValueError:
+            # Topology-unaware fallback (virtual CPU devices have no
+            # slice_index). Keep the hybrid contract: per axis, the DCN
+            # factor is the OUTER dimension, so ICI-contiguous device
+            # groups stay contiguous within each axis.
+            d0, d1, d2 = dcn_shape
+            i0, i1, i2 = shape
+            device_array = (
+                np.asarray(devices)
+                .reshape(d0, d1, d2, i0, i1, i2)
+                .transpose(0, 3, 1, 4, 2, 5)
+                .reshape(d0 * i0, d1 * i1, d2 * i2)
+            )
     else:
         try:
             device_array = mesh_utils.create_device_mesh(
@@ -104,10 +156,17 @@ def build_mesh(
     return Mesh(device_array, axis_names=AXIS_NAMES)
 
 
-def mesh_from_config(parallel: str, mesh_cfg: MeshConfig, devices: list | None = None) -> Mesh:
+def mesh_from_config(
+    parallel: str,
+    mesh_cfg: MeshConfig,
+    devices: list | None = None,
+    n_layers: int | None = None,
+) -> Mesh:
     """One-call mesh construction used by the trainer and tests."""
     devices = list(devices if devices is not None else jax.devices())
     dcn = (mesh_cfg.dcn_pipe, mesh_cfg.dcn_data, mesh_cfg.dcn_model)
     n_ici = len(devices) // math.prod(dcn)
-    shape = resolve_mesh_shape(parallel, n_ici, mesh_cfg)
+    shape = resolve_mesh_shape(
+        parallel, n_ici, mesh_cfg, n_layers=n_layers, pipe_dcn=mesh_cfg.dcn_pipe
+    )
     return build_mesh(shape, devices=devices, dcn_shape=dcn)

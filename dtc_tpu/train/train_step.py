@@ -92,14 +92,22 @@ def create_gspmd_train_step(
 
 def create_eval_step(
     mesh: Mesh,
+    model,
     rules: Sequence[tuple[str, str | None]] = DEFAULT_RULES,
-) -> Callable[[TrainState, Batch], jax.Array]:
-    """Jitted loss-only evaluation step (no dropout, no update)."""
+) -> Callable[[PyTree, Batch], jax.Array]:
+    """Jitted loss-only evaluation step (no dropout, no update).
+
+    Takes bare params (not a TrainState) so the trainer can feed it
+    unstacked pipeline params: eval always runs the plain GSPMD forward,
+    whatever strategy training uses.
+    """
 
     @jax.jit
-    def eval_step(state: TrainState, batch: Batch) -> jax.Array:
-        logits = state.apply_fn({"params": state.params}, batch.x, train=False)
-        return cross_entropy_loss(logits, batch.y)
+    def eval_step(params: PyTree, batch: Batch) -> jax.Array:
+        x = nn.with_logical_constraint(batch.x, ("batch", "seq"))
+        y = nn.with_logical_constraint(batch.y, ("batch", "seq"))
+        logits = model.apply({"params": params}, x, train=False)
+        return cross_entropy_loss(logits, y)
 
     return eval_step
 

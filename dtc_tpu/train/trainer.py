@@ -55,34 +55,65 @@ class TrainResult:
     state: TrainState
     losses: list[float] = field(default_factory=list)
     elapsed_times: list[float] = field(default_factory=list)
+    eval_losses: list[tuple[int, float]] = field(default_factory=list)
     mesh: Mesh | None = None
 
 
+def _per_process_batch(train_cfg: TrainConfig) -> int:
+    n = jax.process_count()
+    if n > 1 and train_cfg.batch % n != 0:
+        raise ValueError(
+            f"global batch {train_cfg.batch} not divisible by {n} processes"
+        )
+    return train_cfg.batch // n if n > 1 else train_cfg.batch
+
+
 def make_host_iterator(
-    train_cfg: TrainConfig, model_cfg: ModelConfig, skip_batches: int = 0
+    train_cfg: TrainConfig,
+    model_cfg: ModelConfig,
+    skip_batches: int = 0,
+    seed_offset: int = 0,
 ) -> Iterator[np.ndarray]:
     """(batch, seq_len+1) token batches; per-process share in multi-host runs.
 
     ``skip_batches`` positions the stream past already-consumed batches on
     resume — O(1) for the seeded synthetic stream, a drain loop for
-    streaming datasets."""
+    streaming datasets. ``seed_offset`` selects a disjoint synthetic stream
+    (used by eval)."""
     seq = model_cfg.max_seq_len + 1
-    batch = train_cfg.batch
-    if jax.process_count() > 1:
-        assert batch % jax.process_count() == 0
-        batch = batch // jax.process_count()
+    batch = _per_process_batch(train_cfg)
     if train_cfg.dataset == "synthetic":
         # Offset multi-host streams so processes contribute distinct data.
-        seed = train_cfg.seed * 1000 + jax.process_index()
+        seed = train_cfg.seed * 1000 + seed_offset + jax.process_index()
         return synthetic_batch_iterator(
             batch, seq, model_cfg.vocab_size, seed=seed, start=skip_batches
         )
     from dtc_tpu.data.fineweb import fineweb_batch_iterator
 
-    it = fineweb_batch_iterator(batch, seq)
+    it = fineweb_batch_iterator(
+        batch,
+        seq,
+        process_index=jax.process_index(),
+        process_count=jax.process_count(),
+    )
     for _ in range(skip_batches):
         next(it)
     return it
+
+
+def make_eval_iterator(
+    train_cfg: TrainConfig, model_cfg: ModelConfig
+) -> Iterator[np.ndarray]:
+    """Eval batches for the periodic eval pass.
+
+    Synthetic: a seed stream fully disjoint from training's
+    (seed_offset=500; training streams use offsets < number of processes).
+    FineWeb: streaming has no held-out split, so this returns a fresh
+    stream from the dataset head — the eval set is EXACTLY the first
+    ``eval_batches`` training batches. That makes fineweb eval a smoke
+    check (is the forward pass sane), not a generalization measure.
+    """
+    return make_host_iterator(train_cfg, model_cfg, seed_offset=500)
 
 
 def init_state(
@@ -135,7 +166,9 @@ def train(
 ) -> TrainResult:
     maybe_initialize_distributed(train_cfg.multihost)
     num_devices = jax.device_count()
-    mesh = mesh_from_config(train_cfg.parallel, train_cfg.mesh)
+    mesh = mesh_from_config(
+        train_cfg.parallel, train_cfg.mesh, n_layers=model_cfg.n_layers
+    )
     if model_cfg.attention == "ring" and rules is DEFAULT_RULES:
         # Ring attention repurposes the "model" mesh axis for sequence
         # parallelism; swap in the rule table that shards seq instead of
@@ -209,6 +242,62 @@ def train(
             if train_cfg.output_dir and lead
             else None
         )
+        # Auto timing semantics: when rows are being logged, sync each step
+        # so elapsed_time is step time, not dispatch time (see schema.py).
+        sync_every_step = train_cfg.sync_every_step
+        if sync_every_step is None:
+            sync_every_step = bool(train_cfg.output_dir)
+
+        # ------ periodic held-out eval ------
+        eval_fn = None
+        if train_cfg.eval_every > 0:
+            from dtc_tpu.data.prefetch import split_put
+            from dtc_tpu.train.train_step import create_eval_step
+
+            eval_fn = create_eval_step(mesh, model, rules=rules)
+            eval_it = make_eval_iterator(train_cfg, model_cfg)
+            spec = batch_spec(rules)
+            eval_set = [
+                split_put(next(eval_it), mesh, spec)
+                for _ in range(train_cfg.eval_batches)
+            ]
+            eval_csv = (
+                CSVLogger(
+                    os.path.join(train_cfg.output_dir, "eval_log.csv"),
+                    fieldnames=("step", "loss"),
+                )
+                if train_cfg.output_dir and lead
+                else None
+            )
+
+        def run_eval(step: int) -> float:
+            """Returns the wall-clock the eval pass took, so the caller can
+            keep it out of the cumulative training elapsed_time."""
+            # Drain pending async training steps BEFORE the eval clock
+            # starts: their device time must stay in training elapsed_time,
+            # not be absorbed into (and subtracted as) eval time.
+            if device_losses:
+                jax.device_get(device_losses[-1])
+            t0 = time.perf_counter()
+            # Pipeline params are stacked (S, L/S, ...); eval runs the plain
+            # GSPMD forward, so unstack a view first.
+            from dtc_tpu.parallel.pipeline import pp_unstack_params
+
+            params = state.params
+            if mesh.shape.get("pipe", 1) > 1:
+                params = pp_unstack_params(params)
+            vals = [
+                float(jax.device_get(eval_fn(params, Batch(x=x, y=y))))
+                for x, y in eval_set
+            ]
+            el = float(np.mean(vals))
+            result.eval_losses.append((step, el))
+            if lead:
+                print(f"Eval @ step {step}: loss {el:.4f}")
+            if eval_csv:
+                eval_csv.log(step=step, loss=el)
+                eval_csv.flush()
+            return time.perf_counter() - t0
 
         # ------ warmup (untimed, excluded from measurement; ref uses 5) ------
         warmup_steps = 0 if start_step > 0 else train_cfg.warmup_steps
@@ -258,7 +347,7 @@ def train(
             x, y = next(data_it)
             state, loss = train_step(state, Batch(x=x, y=y), jax.random.fold_in(key, step))
             device_losses.append(loss)
-            if train_cfg.sync_every_step:
+            if sync_every_step:
                 jax.block_until_ready(loss)
             now = time.perf_counter()
             result.elapsed_times.append(now - start_time)
@@ -290,6 +379,18 @@ def train(
                 window_start = time.perf_counter()
                 window_steps = 0
 
+            if eval_fn is not None and (
+                step % train_cfg.eval_every == 0 or step == train_cfg.steps
+            ):
+                eval_dt = run_eval(step)
+                # Keep eval out of both the cumulative elapsed_time (shift
+                # the epoch forward by the eval duration — rows stay pure
+                # training time, comparable to the eval-less reference) and
+                # the next window's step-time accounting.
+                start_time += eval_dt
+                window_start = time.perf_counter()
+                window_steps = 0
+
             if ckpt and step % train_cfg.checkpoint_every == 0:
                 ckpt.save(step, state)
 
@@ -303,5 +404,7 @@ def train(
             ckpt.close()
         if csv:
             csv.close()
+        if eval_fn is not None and eval_csv:
+            eval_csv.close()
         result.state = state
         return result

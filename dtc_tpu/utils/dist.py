@@ -2,37 +2,64 @@
 
 The reference is strictly single-process — no ``jax.distributed.initialize``
 anywhere (SURVEY.md §2.2 "Multi-host"). Here multi-host is first-class:
-initialize once at entry, then every process builds the same global mesh and
-feeds its local shard of the batch (see ``data/prefetch.py``); logging and
-checkpoint writes happen on process 0 only.
+initialize once at entry — BEFORE any other JAX API touches the backend —
+then every process builds the same global mesh and feeds its local shard of
+the batch (see ``data/prefetch.py``); logging and checkpoint writes happen
+on process 0 only.
 """
 
 from __future__ import annotations
 
 import os
 
-import jax
+_initialized = False
 
 
 def maybe_initialize_distributed(multihost: bool) -> None:
     """Initialize the JAX distributed runtime when running multi-process.
 
-    Safe to call unconditionally: no-ops unless ``multihost`` is set or the
-    standard cluster env (JAX_COORDINATOR_ADDRESS / TPU pod metadata) marks
-    this as a multi-process run.
+    MUST be the first JAX-touching call of the process: probing any backend
+    API (``jax.process_count()``, ``jax.devices()``, …) first initializes
+    the local backend and makes ``jax.distributed.initialize()`` raise on a
+    real pod. The gate is therefore env/config only — no JAX probes.
+
+    Raises on failure when multi-host was explicitly requested (config):
+    a pod where every host silently falls back to independent
+    single-process training is far worse than a crash. When only the
+    environment hints at a cluster (a coordinator address left set by
+    some other tool), failure degrades to a warning + single-process —
+    the config didn't ask for multi-host.
     """
-    if jax.process_count() > 1:
-        return  # already initialized
+    global _initialized
+    if _initialized:
+        return
     env_says_cluster = bool(
         os.environ.get("JAX_COORDINATOR_ADDRESS") or os.environ.get("COORDINATOR_ADDRESS")
     )
     if not (multihost or env_says_cluster):
         return
+    import jax
+
     try:
         jax.distributed.initialize()
-    except Exception as e:  # single-process fallback keeps local runs working
-        print(f"[dtc_tpu] jax.distributed.initialize() skipped: {e}")
+    except RuntimeError as e:
+        # The embedding program (a launcher, a test harness) may have
+        # initialized the distributed runtime itself — that is success,
+        # not failure.
+        if "already initialized" not in str(e).lower():
+            raise
+    except Exception:
+        if multihost:
+            raise
+        print(
+            "[dtc_tpu] WARNING: cluster env vars set but "
+            "jax.distributed.initialize() failed; continuing single-process"
+        )
+        return
+    _initialized = True
 
 
 def is_lead_process() -> bool:
+    import jax
+
     return jax.process_index() == 0

@@ -24,6 +24,12 @@ def main(train_config_path: str, model_config_path: str | None, optim_config_pat
         train_config_path, model_config_path, optim_config_path
     )
 
+    # Multi-host init FIRST: jax.distributed.initialize() must run before
+    # any backend-touching JAX API (including jax.device_count below).
+    from dtc_tpu.utils.dist import maybe_initialize_distributed
+
+    maybe_initialize_distributed(train_cfg.multihost)
+
     if train_cfg.dataset == "fineweb":
         # vocab_size comes from the tokenizer, as in /root/reference/main.py:17-18.
         from dtc_tpu.data.tokenizer import get_tokenizer

@@ -12,7 +12,7 @@ from __future__ import annotations
 
 import os
 
-STRATEGIES = ("dp", "tp", "pp", "3d")
+STRATEGIES = ("dp", "tp", "pp", "3d", "tpu_dp")
 
 
 def main(output_root: str = "outputs") -> None:
@@ -30,25 +30,43 @@ def main(output_root: str = "outputs") -> None:
     if not runs:
         raise SystemExit(f"no log.csv found under {output_root}/{{{','.join(STRATEGIES)}}}")
 
-    fig, ax = plt.subplots(figsize=(8, 5))
-    for s, df in runs.items():
-        ax.plot(df["step"], df["loss"], label=s, linewidth=0.8)
-    ax.set_xlabel("step")
-    ax.set_ylabel("loss")
-    ax.set_title("Training loss by parallelism strategy")
-    ax.legend()
-    fig.tight_layout()
-    fig.savefig(os.path.join(output_root, "loss.png"), dpi=150)
+    # tpu_dp runs a different model scale — comparing it against the
+    # small-scale strategy runs in either chart would mislead; it gets its
+    # own loss plot below.
+    small = {s: df for s, df in runs.items() if s != "tpu_dp"}
 
-    fig, ax = plt.subplots(figsize=(6, 5))
-    names = list(runs)
-    totals = [float(df["elapsed_time"].iloc[-1]) for df in runs.values()]
-    ax.bar(names, totals)
-    ax.set_ylabel("total wall-clock (s)")
-    ax.set_title("Total training time by strategy")
-    fig.tight_layout()
-    fig.savefig(os.path.join(output_root, "average_elapsed_time.png"), dpi=150)
-    print(f"wrote {output_root}/loss.png and {output_root}/average_elapsed_time.png")
+    if small:
+        fig, ax = plt.subplots(figsize=(8, 5))
+        for s, df in small.items():
+            ax.plot(df["step"], df["loss"], label=s, linewidth=0.8)
+        ax.set_xlabel("step")
+        ax.set_ylabel("loss")
+        ax.set_title("Training loss by parallelism strategy")
+        ax.legend()
+        fig.tight_layout()
+        fig.savefig(os.path.join(output_root, "loss.png"), dpi=150)
+
+        fig, ax = plt.subplots(figsize=(6, 5))
+        names = list(small)
+        totals = [float(df["elapsed_time"].iloc[-1]) for df in small.values()]
+        ax.bar(names, totals)
+        ax.set_ylabel("total wall-clock (s)")
+        ax.set_title("Total training time by strategy")
+        fig.tight_layout()
+        fig.savefig(os.path.join(output_root, "average_elapsed_time.png"), dpi=150)
+        print(f"wrote {output_root}/loss.png and {output_root}/average_elapsed_time.png")
+
+    if "tpu_dp" in runs:
+        df = runs["tpu_dp"]
+        fig, ax = plt.subplots(figsize=(8, 5))
+        ax.plot(df["step"], df["loss"], label="tpu_dp (flagship, 1 chip)", linewidth=0.8)
+        ax.set_xlabel("step")
+        ax.set_ylabel("loss")
+        ax.set_title("Flagship GPT-89.6M on TPU (dp)")
+        ax.legend()
+        fig.tight_layout()
+        fig.savefig(os.path.join(output_root, "tpu_loss.png"), dpi=150)
+        print(f"wrote {output_root}/tpu_loss.png")
 
 
 if __name__ == "__main__":

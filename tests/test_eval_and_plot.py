@@ -1,0 +1,76 @@
+"""Periodic eval wiring + plot.py end-to-end (round-2 VERDICT "dead corners")."""
+
+import os
+
+import numpy as np
+
+from dtc_tpu.config.schema import MeshConfig
+from tests.conftest import make_train_cfg
+
+
+def test_eval_runs_and_is_finite(tiny_model_cfg, opt_cfg, tmp_path):
+    from dtc_tpu.train.trainer import train
+
+    cfg = make_train_cfg(
+        "dp", steps=4, eval_every=2, eval_batches=2, output_dir=str(tmp_path)
+    )
+    res = train(cfg, tiny_model_cfg, opt_cfg)
+    steps = [s for s, _ in res.eval_losses]
+    assert steps == [2, 4]
+    assert all(np.isfinite(v) for _, v in res.eval_losses)
+    # Eval loss at a tiny-vocab init sits near log(vocab); after 4 steps it
+    # must still be in a sane band.
+    assert 0 < res.eval_losses[-1][1] < 10
+    assert os.path.exists(tmp_path / "eval_log.csv")
+    rows = (tmp_path / "eval_log.csv").read_text().strip().splitlines()
+    assert rows[0] == "step,loss" and len(rows) == 3
+
+
+def test_eval_works_under_pp(tiny_model_cfg, opt_cfg):
+    """Eval unstacks pipeline params and runs the GSPMD forward."""
+    from dtc_tpu.train.trainer import train
+
+    cfg = make_train_cfg(
+        "pp", steps=2, eval_every=2, eval_batches=1, pp_microbatches=2,
+        mesh=MeshConfig(pipe=2, data=4, model=1),
+    )
+    res = train(cfg, tiny_model_cfg, opt_cfg)
+    assert len(res.eval_losses) == 1 and np.isfinite(res.eval_losses[0][1])
+
+
+def test_eval_loss_matches_manual_forward(tiny_model_cfg, opt_cfg):
+    """The wired eval path computes the same number as a hand-rolled
+    dropout-free forward pass on the same batches."""
+    import jax
+
+    from dtc_tpu.models.gpt import GPT
+    from dtc_tpu.train.train_step import cross_entropy_loss
+    from dtc_tpu.train.trainer import make_eval_iterator, train
+
+    cfg = make_train_cfg("dp", steps=2, eval_every=2, eval_batches=2)
+    res = train(cfg, tiny_model_cfg, opt_cfg)
+    model = GPT(tiny_model_cfg)
+    it = make_eval_iterator(cfg, tiny_model_cfg)
+    vals = []
+    params = jax.device_get(res.state.params)
+    for _ in range(2):
+        tok = next(it)
+        logits = model.apply({"params": params}, tok[:, :-1], train=False)
+        vals.append(float(cross_entropy_loss(logits, tok[:, 1:])))
+    np.testing.assert_allclose(res.eval_losses[-1][1], np.mean(vals), rtol=1e-5)
+
+
+def test_plot_end_to_end(tmp_path):
+    """plot.py consumes the reference CSV schema and writes both PNGs."""
+    import plot
+
+    for s, offs in (("dp", 0.0), ("tp", 0.01), ("pp", 0.02), ("3d", 0.03)):
+        d = tmp_path / s
+        d.mkdir()
+        with open(d / "log.csv", "w") as f:
+            f.write("step,elapsed_time,loss\n")
+            for i in range(1, 51):
+                f.write(f"{i},{i * 0.1 + offs},{5.0 / i + offs}\n")
+    plot.main(str(tmp_path))
+    assert (tmp_path / "loss.png").exists()
+    assert (tmp_path / "average_elapsed_time.png").exists()

@@ -1,0 +1,91 @@
+"""PP seq-chunked vocab compute: parity + the FLOP reduction it exists for.
+
+Round-2 VERDICT "What's weak" #4: every pipeline stage used to compute the
+full embed one-hot matmul and the full head matmul + CE over all M
+microbatches, masked on all but one stage — ~2x(S-1) redundant vocab-matmul
+passes per step. The chunked path gives each stage t/S positions; these
+tests pin (a) numerical parity with the replicated fallback and with DP,
+and (b) that the compiled step's total FLOPs actually dropped.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+
+from dtc_tpu.config.schema import MeshConfig
+from dtc_tpu.models.gpt import GPT
+from dtc_tpu.parallel.mesh import mesh_from_config
+from dtc_tpu.parallel.pipeline import create_pp_train_step
+from dtc_tpu.parallel.sharding import DEFAULT_RULES
+from dtc_tpu.train.train_step import Batch
+from dtc_tpu.train.trainer import init_state
+from tests.conftest import make_train_cfg
+
+
+def _setup(tiny_model_cfg, opt_cfg, pipe=4, data=2, microbatches=2):
+    train_cfg = make_train_cfg(
+        "pp", pp_microbatches=microbatches, mesh=MeshConfig(pipe=pipe, data=data)
+    )
+    mesh = mesh_from_config("pp", train_cfg.mesh, n_layers=tiny_model_cfg.n_layers)
+    model = GPT(tiny_model_cfg)
+    with mesh, nn.logical_axis_rules(DEFAULT_RULES):
+        state = init_state(model, tiny_model_cfg, train_cfg, opt_cfg, mesh, DEFAULT_RULES)
+    rng = np.random.default_rng(0)
+    x = rng.integers(0, tiny_model_cfg.vocab_size, (8, tiny_model_cfg.max_seq_len))
+    y = rng.integers(0, tiny_model_cfg.vocab_size, (8, tiny_model_cfg.max_seq_len))
+    batch = Batch(x=jnp.asarray(x, jnp.int32), y=jnp.asarray(y, jnp.int32))
+    return model, mesh, state, batch
+
+
+def test_chunked_matches_replicated(tiny_model_cfg, opt_cfg):
+    model, mesh, state, batch = _setup(tiny_model_cfg, opt_cfg)
+    key = jax.random.PRNGKey(0)
+    with mesh, nn.logical_axis_rules(DEFAULT_RULES):
+        state2 = jax.tree.map(jnp.copy, state)
+        step_c = create_pp_train_step(model, mesh, num_microbatches=2, chunk_vocab=True)
+        step_r = create_pp_train_step(model, mesh, num_microbatches=2, chunk_vocab=False)
+        s_c, loss_c = step_c(state, batch, key)
+        s_r, loss_r = step_r(state2, batch, key)
+    np.testing.assert_allclose(float(loss_c), float(loss_r), rtol=1e-5)
+    for a, b in zip(jax.tree.leaves(s_c.params), jax.tree.leaves(s_r.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+
+def test_chunked_cuts_total_flops(tiny_model_cfg, opt_cfg):
+    """Compiled-step FLOPs: the chunked path removes O((S-1)/S) of the vocab
+    matmul work. With tiny dims the vocab matmuls are a modest slice of the
+    step, so assert a measurable (>5%) drop rather than a specific ratio."""
+    model, mesh, state, batch = _setup(tiny_model_cfg, opt_cfg)
+    key = jax.random.PRNGKey(0)
+
+    def flops(chunk):
+        with mesh, nn.logical_axis_rules(DEFAULT_RULES):
+            step = create_pp_train_step(
+                model, mesh, num_microbatches=2, chunk_vocab=chunk
+            )
+            lowered = jax.jit(lambda s, b, k: step(s, b, k)).lower(state, batch, key)
+            cost = lowered.compile().cost_analysis()
+        if isinstance(cost, list):
+            cost = cost[0]
+        return cost["flops"]
+
+    f_chunked = flops(True)
+    f_replicated = flops(False)
+    assert f_chunked < 0.95 * f_replicated, (
+        f"chunked={f_chunked:.3e} replicated={f_replicated:.3e}"
+    )
+
+
+def test_chunked_pp_still_matches_dp(tiny_model_cfg, opt_cfg):
+    from dtc_tpu.train.trainer import train
+
+    r_dp = train(make_train_cfg("dp"), tiny_model_cfg, opt_cfg)
+    r_pp = train(
+        make_train_cfg(
+            "pp", pp_microbatches=2, mesh=MeshConfig(pipe=4, data=2, model=1)
+        ),
+        tiny_model_cfg,
+        opt_cfg,
+    )
+    np.testing.assert_allclose(r_dp.losses, r_pp.losses, rtol=5e-4, atol=5e-4)
