@@ -359,12 +359,14 @@ def train(
                 # per-array fetch costs a full RTT on tunneled platforms.
                 losses = [float(v) for v in jax.device_get(jnp.stack(device_losses))]
                 now = time.perf_counter()  # after the device sync
-                # Boundary rows are ALWAYS device-synced times: re-stamp the
-                # window's last row post-fetch so that even with
-                # sync_every_step off, every log_every-th elapsed_time (and
-                # the final total) reflects completed device work.
-                pending_rows[-1] = (pending_rows[-1][0], now - start_time)
-                result.elapsed_times[-1] = now - start_time
+                # With per-step sync OFF, rows are dispatch-stamped:
+                # re-stamp the window's last row post-fetch so every
+                # log_every-th elapsed_time (and the final total) reflects
+                # completed device work. With sync ON every row is already
+                # device-synced — re-stamping would add the loss-fetch RTT.
+                if not sync_every_step:
+                    pending_rows[-1] = (pending_rows[-1][0], now - start_time)
+                    result.elapsed_times[-1] = now - start_time
                 result.losses.extend(losses)
                 if csv:
                     for (s, el), lo in zip(pending_rows, losses):

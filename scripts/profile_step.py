@@ -1,0 +1,110 @@
+"""Profile the flagship train step on the attached TPU and print the
+per-fusion time breakdown (the PERF.md methodology).
+
+Usage: python scripts/profile_step.py [--batch 32] [--heads 16] [--steps 6]
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import gzip
+import json
+import os
+import sys
+from collections import defaultdict
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def run(batch: int, heads: int, steps: int, trace_dir: str, remat: bool) -> float:
+    import time
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from flax import linen as nn
+
+    from dtc_tpu.config.schema import MeshConfig, ModelConfig, OptimConfig, TrainConfig
+    from dtc_tpu.data.synthetic import synthetic_batch_iterator
+    from dtc_tpu.models.gpt import GPT
+    from dtc_tpu.parallel.mesh import mesh_from_config
+    from dtc_tpu.parallel.sharding import DEFAULT_RULES
+    from dtc_tpu.train.train_step import Batch, create_train_step
+    from dtc_tpu.train.trainer import init_state
+
+    model_cfg = ModelConfig(
+        vocab_size=50258, d_model=512, n_layers=12, n_heads=heads, d_ff=2048,
+        max_seq_len=512, dropout=0.1, param_dtype="float32",
+        compute_dtype="bfloat16", attention="auto", remat=remat,
+    )
+    opt_cfg = OptimConfig(lr=3e-4, weight_decay=0.1, grad_clip=1.0)
+    train_cfg = TrainConfig(
+        seed=0, parallel="dp", batch=batch, steps=1, log_every=1, output_dir="",
+        dataset="synthetic", warmup_steps=0, prefetch=0, mesh=MeshConfig(),
+    )
+    mesh = mesh_from_config("dp", train_cfg.mesh)
+    model = GPT(model_cfg)
+    with mesh, nn.logical_axis_rules(DEFAULT_RULES):
+        state = init_state(model, model_cfg, train_cfg, opt_cfg, mesh, DEFAULT_RULES)
+        step_fn = create_train_step(mesh, model=model)
+        tok = next(synthetic_batch_iterator(batch, 513, model_cfg.vocab_size))
+        x, y = jnp.asarray(tok[:, :-1]), jnp.asarray(tok[:, 1:])
+        key = jax.random.key(0, impl="rbg")
+        for i in range(5):
+            state, loss = step_fn(state, Batch(x=x, y=y), jax.random.fold_in(key, i))
+        float(np.asarray(loss))
+        with jax.profiler.trace(trace_dir):
+            for i in range(steps):
+                state, loss = step_fn(state, Batch(x=x, y=y), jax.random.fold_in(key, 10 + i))
+            float(np.asarray(loss))
+        t0 = time.perf_counter()
+        for i in range(20):
+            state, loss = step_fn(state, Batch(x=x, y=y), jax.random.fold_in(key, 40 + i))
+        float(np.asarray(loss))
+        return (time.perf_counter() - t0) / 20
+
+
+def parse(trace_dir: str, steps: int, top: int):
+    paths = glob.glob(os.path.join(trace_dir, "**", "*.trace.json.gz"), recursive=True)
+    assert paths, f"no trace under {trace_dir}"
+    path = max(paths, key=os.path.getmtime)
+    with gzip.open(path, "rt") as f:
+        data = json.load(f)
+    events = data.get("traceEvents", [])
+    # Device-side complete events: pid whose name mentions TPU/device XLA ops.
+    by_name = defaultdict(float)
+    pids = {}
+    for e in events:
+        if e.get("ph") == "M" and e.get("name") == "process_name":
+            pids[e["pid"]] = e["args"].get("name", "")
+    dev_pids = {p for p, n in pids.items() if "TPU" in n or "/device" in n.lower()}
+    for e in events:
+        if e.get("ph") == "X" and e.get("pid") in dev_pids:
+            name = e.get("name", "")
+            # Skip umbrella events: jit_* module spans and bare step-number
+            # markers wrap the real op events and would double-count.
+            if name.startswith("jit_") or name.isdigit():
+                continue
+            by_name[name] += e.get("dur", 0) / 1e6  # us -> s
+    rows = sorted(by_name.items(), key=lambda kv: -kv[1])[:top]
+    print(f"# trace: {path}")
+    print("# NOTE: rows are NOT additive — while.N loop ops nest the ops")
+    print("# executed inside them (e.g. attn.* kernels run within the scan).")
+    for name, dur in rows:
+        print(f"{dur / steps * 1e3:8.3f} ms/step  {name[:110]}")
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=32)
+    ap.add_argument("--heads", type=int, default=16)
+    ap.add_argument("--steps", type=int, default=6)
+    ap.add_argument("--top", type=int, default=40)
+    ap.add_argument("--no-remat", action="store_true")
+    ap.add_argument("--trace-dir", default="/tmp/dtc_trace")
+    args = ap.parse_args()
+    step_time = run(args.batch, args.heads, args.steps, args.trace_dir, not args.no_remat)
+    print(f"# measured step time: {step_time * 1e3:.2f} ms")
+    parse(args.trace_dir, args.steps, args.top)

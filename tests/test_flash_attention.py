@@ -8,6 +8,7 @@ reference semantics (`/root/reference/model/CausalSelfAttention.py:34-42`).
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 import pytest
 
 from dtc_tpu.ops.attention import causal_attention, dense_causal_attention
@@ -81,3 +82,64 @@ def test_dispatch_unknown_impl():
     q, k, v = _qkv(jax.random.PRNGKey(3), 1, 32, 2, 16)
     with pytest.raises(ValueError):
         causal_attention(q, k, v, impl="nope")
+
+
+# ---- packed transpose-free path (single tile, heads grouped into lanes) ----
+
+PACKED_CASES = [
+    # (t, d, h): g = 128//d heads per lane group; h % g == 0 engages packing
+    (256, 32, 8),
+    (512, 32, 16),   # the flagship shape exactly
+    (256, 64, 4),
+    (256, 128, 2),   # g=1: packed degenerates to per-head lane blocks
+]
+
+
+@pytest.mark.parametrize("t,d,h", PACKED_CASES)
+def test_packed_forward_parity(t, d, h):
+    from dtc_tpu.ops.flash_attention import _packed_group
+
+    assert _packed_group(d, h) is not None  # the case actually packs
+    q, k, v = _qkv(jax.random.PRNGKey(0), 2, t, h, d)
+    got = flash_causal_attention(q, k, v, block_q=t, block_kv=t)
+    ref = dense_causal_attention(q, k, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=2e-5)
+
+
+@pytest.mark.parametrize("t,d,h", [(256, 32, 8), (256, 64, 4)])
+def test_packed_grad_parity(t, d, h):
+    q, k, v = _qkv(jax.random.PRNGKey(1), 2, t, h, d)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_causal_attention(q, k, v, block_q=t, block_kv=t) ** 2)
+
+    def loss_dense(q, k, v):
+        return jnp.sum(dense_causal_attention(q, k, v) ** 2)
+
+    g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_dense = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for name, a, b in zip("qkv", g_dense, g_flash):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a), atol=2e-4,
+                                   err_msg=f"d{name}")
+
+
+def test_packed_group_predicate():
+    """The dispatcher packs exactly when 128 % head_dim == 0 and the group
+    divides the head count."""
+    from dtc_tpu.ops.flash_attention import _packed_group
+
+    assert _packed_group(32, 8) == 4
+    assert _packed_group(32, 3) is None
+    assert _packed_group(64, 4) == 2
+    assert _packed_group(128, 2) == 1
+    assert _packed_group(256, 4) is None  # head_dim wider than the lane block
+
+
+def test_packed_matches_unpacked_kernel():
+    """Same shape through BOTH code paths: block_q = t engages the packed
+    single-tile kernels, block_q = t // 2 forces the transpose/multi-tile
+    path. Their outputs must agree to fp32 accumulation noise."""
+    q, k, v = _qkv(jax.random.PRNGKey(5), 2, 256, 8, 32)
+    packed = flash_causal_attention(q, k, v, block_q=256, block_kv=256)
+    unpacked = flash_causal_attention(q, k, v, block_q=128, block_kv=128)
+    np.testing.assert_allclose(np.asarray(packed), np.asarray(unpacked), atol=2e-5)
