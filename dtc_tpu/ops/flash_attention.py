@@ -410,6 +410,54 @@ def _packed_group(d: int, h: int) -> int | None:
     return g if h % g == 0 else None
 
 
+def _packed_scores(qt, kt, sl, scale, mask):
+    """Masked fp32 score tile for head slice ``sl`` of packed q/k tiles."""
+    s = jax.lax.dot_general(
+        qt[:, sl] * scale, kt[:, sl], (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    return jnp.where(mask, s, NEG_INF)
+
+
+def _packed_tile_bwd(qt, kt, vt, dot_, ot, lse, mask, sl, scale, delta=None):
+    """Shared per-head backward tile math for the packed kernels: recompute
+    p from the saved lse, form ds = p*(dp - delta), and return the three
+    fp32 gradient contributions (dq, dk, dv) for head slice ``sl``.
+    ``delta`` (rowsum(dO ⊙ O), depends only on the q block) may be passed
+    in precomputed; None computes it from the tiles."""
+    qs = qt[:, sl] * scale
+    k = kt[:, sl]
+    do = dot_[:, sl]
+    s = jax.lax.dot_general(
+        qs, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    p = jnp.exp(s - lse)
+    p = jnp.where(mask, p, 0.0)
+    if delta is None:
+        delta = jnp.sum(
+            do.astype(jnp.float32) * ot[:, sl].astype(jnp.float32),
+            axis=-1, keepdims=True,
+        )
+    dp = jax.lax.dot_general(
+        do, vt[:, sl], (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    ds = p * (dp - delta)
+    dq_c = jax.lax.dot_general(
+        ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * scale
+    dk_c = jax.lax.dot_general(
+        ds.astype(qs.dtype), qs, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    dv_c = jax.lax.dot_general(
+        p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    return dq_c, dk_c, dv_c
+
+
 def _fwd_kernel_packed(q_ref, k_ref, v_ref, o_ref, lse_ref, *,
                        block_q, block_kv, g, d, scale):
     """Single-KV-tile forward on packed (B, T, H*D) inputs; one grid slot
@@ -419,12 +467,7 @@ def _fwd_kernel_packed(q_ref, k_ref, v_ref, o_ref, lse_ref, *,
     qt, kt, vt = q_ref[0], k_ref[0], v_ref[0]      # (bq, g*d), (bkv, g*d)
     for gg in range(g):
         sl = slice(gg * d, (gg + 1) * d)
-        q = qt[:, sl] * scale                       # (block_q, d)
-        s = jax.lax.dot_general(
-            q, kt[:, sl], (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        s = jnp.where(mask, s, NEG_INF)
+        s = _packed_scores(qt, kt, sl, scale, mask)
         m = jnp.max(s, axis=-1, keepdims=True)
         p = jnp.exp(s - m)
         l = jnp.sum(p, axis=-1, keepdims=True)
@@ -446,38 +489,13 @@ def _bwd_kernel_packed(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
     dot_, ot = do_ref[0], o_ref[0]
     for gg in range(g):
         sl = slice(gg * d, (gg + 1) * d)
-        qs = qt[:, sl] * scale                     # pre-scaled q tile
-        k = kt[:, sl]
-        do = dot_[:, sl]
         lse = lse_ref[0, 0, :, gg : gg + 1]        # (block_q, 1) fp32
-        s = jax.lax.dot_general(
-            qs, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        dq_c, dk_c, dv_c = _packed_tile_bwd(
+            qt, kt, vt, dot_, ot, lse, mask, sl, scale
         )
-        p = jnp.exp(s - lse)
-        p = jnp.where(mask, p, 0.0)
-        delta = jnp.sum(
-            do.astype(jnp.float32) * ot[:, sl].astype(jnp.float32),
-            axis=-1, keepdims=True,
-        )
-        dp = jax.lax.dot_general(
-            do, vt[:, sl], (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        ds = p * (dp - delta)
-        dq_ref[0, :, sl] = (
-            jax.lax.dot_general(
-                ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32,
-            ) * scale
-        ).astype(dq_ref.dtype)
-        dk_ref[0, :, sl] = jax.lax.dot_general(
-            ds.astype(qs.dtype), qs, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        ).astype(dk_ref.dtype)
-        dv_ref[0, :, sl] = jax.lax.dot_general(
-            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        ).astype(dv_ref.dtype)
+        dq_ref[0, :, sl] = dq_c.astype(dq_ref.dtype)
+        dk_ref[0, :, sl] = dk_c.astype(dk_ref.dtype)
+        dv_ref[0, :, sl] = dv_c.astype(dv_ref.dtype)
 
 
 def _packed_specs(t, block_q):
@@ -489,62 +507,230 @@ def _packed_specs(t, block_q):
     return dspec, kvspec
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash_packed(q, k, v, block_q, g, d, scale):
-    out, _ = _packed_fwd_call(q, k, v, block_q, g, d, scale)
+# --- packed multi-tile: causal block skipping (25% less compute at 2x2) ---
+
+
+def _fwd_kernel_packed_multi(q_ref, k_ref, v_ref, o_ref, lse_ref,
+                             m_scr, l_scr, acc_scr, *,
+                             block_q, block_kv, g, d, scale):
+    """Online-softmax forward on packed layout, KV blocks walked innermost.
+    Blocks strictly above the causal diagonal are predicated out entirely —
+    the single-tile kernel pays for the whole T² tile, this one only for
+    the lower-triangular blocks. Scratch columns gg hold head gg's running
+    stats; acc uses the same lane slot as the head's output slice."""
+    i, j = pl.program_id(2), pl.program_id(3)
+
+    @pl.when(j == 0)
+    def _():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    @pl.when(j * block_kv <= i * block_q + block_q - 1)
+    def _():
+        mask = _mask(i, j, block_q, block_kv)
+        qt, kt, vt = q_ref[0], k_ref[0], v_ref[0]
+        for gg in range(g):
+            sl = slice(gg * d, (gg + 1) * d)
+            cl = slice(gg, gg + 1)
+            s = _packed_scores(qt, kt, sl, scale, mask)
+            m_prev = m_scr[:, cl]
+            m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+            alpha = jnp.exp(m_prev - m_new)
+            p = jnp.exp(s - m_new)
+            l_scr[:, cl] = alpha * l_scr[:, cl] + jnp.sum(p, axis=-1, keepdims=True)
+            acc_scr[:, sl] = acc_scr[:, sl] * alpha + jax.lax.dot_general(
+                p.astype(vt.dtype), vt[:, sl], (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            m_scr[:, cl] = m_new
+
+    @pl.when(j == pl.num_programs(3) - 1)
+    def _():
+        for gg in range(g):
+            sl = slice(gg * d, (gg + 1) * d)
+            cl = slice(gg, gg + 1)
+            o_ref[0, :, sl] = (acc_scr[:, sl] / l_scr[:, cl]).astype(o_ref.dtype)
+            lse_ref[0, 0, :, cl] = m_scr[:, cl] + jnp.log(l_scr[:, cl])
+
+
+def _bwd_kernel_packed_multi(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
+                             dq_ref, dk_ref, dv_ref,
+                             dq_scr, dk_scr, dv_scr, delta_scr, *,
+                             block_q, block_kv, g, d, scale):
+    """Fused backward on packed layout with causal block skipping.
+
+    Grid (b, hg, i, j), row-major: dq for q-block i accumulates in a small
+    (block_q, 128) scratch reset at j==0 and written at j==last; dk/dv
+    accumulate rows pl.ds(j*block_kv) of full-length (T, 128) scratches —
+    their j-blocks only complete at the final i — and are written whole at
+    the last grid step. p is recomputed ONCE per valid block and feeds all
+    three gradients (the split dq/dkv kernels of the transpose path
+    recompute it twice)."""
+    i, j = pl.program_id(2), pl.program_id(3)
+    nq, nkv = pl.num_programs(2), pl.num_programs(3)
+
+    @pl.when(j == 0)
+    def _():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+        # delta = rowsum(dO ⊙ O) depends only on the q block: compute it
+        # once per i here (j == 0 is always causally valid) instead of
+        # per KV block — saves (nkv - 1) redundant VPU reduces per head.
+        dot_, ot = do_ref[0], o_ref[0]
+        for gg in range(g):
+            sl = slice(gg * d, (gg + 1) * d)
+            delta_scr[:, gg : gg + 1] = jnp.sum(
+                dot_[:, sl].astype(jnp.float32) * ot[:, sl].astype(jnp.float32),
+                axis=-1, keepdims=True,
+            )
+
+    @pl.when((i == 0) & (j == 0))
+    def _():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    @pl.when(j * block_kv <= i * block_q + block_q - 1)
+    def _():
+        mask = _mask(i, j, block_q, block_kv)
+        qt, kt, vt = q_ref[0], k_ref[0], v_ref[0]
+        dot_, ot = do_ref[0], o_ref[0]
+        rows = pl.ds(j * block_kv, block_kv)
+        for gg in range(g):
+            sl = slice(gg * d, (gg + 1) * d)
+            lse = lse_ref[0, 0, :, gg : gg + 1]
+            dq_c, dk_c, dv_c = _packed_tile_bwd(
+                qt, kt, vt, dot_, ot, lse, mask, sl, scale,
+                delta=delta_scr[:, gg : gg + 1],
+            )
+            dq_scr[:, sl] += dq_c
+            dk_scr[rows, sl] += dk_c
+            dv_scr[rows, sl] += dv_c
+
+    @pl.when(j == nkv - 1)
+    def _():
+        dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
+
+    @pl.when((i == nq - 1) & (j == nkv - 1))
+    def _():
+        dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_packed(q, k, v, block_q, block_kv, g, d, scale):
+    out, _ = _packed_fwd_call(q, k, v, block_q, block_kv, g, d, scale)
     return out
 
 
-def _packed_fwd_call(q, k, v, block_q, g, d, scale):
+def _packed_fwd_call(q, k, v, block_q, block_kv, g, d, scale):
     b, t, hd = q.shape
     hg = hd // _LANES
     nq = t // block_q
-    dspec, kvspec = _packed_specs(t, block_q)
-    lsespec = pl.BlockSpec((1, 1, block_q, g), lambda bi, gi, i: (bi, gi, i, 0))
+    if block_kv == t and nq == 1:
+        # Whole tile: one-pass kernel, no online-softmax scratch.
+        dspec, kvspec = _packed_specs(t, block_q)
+        lsespec = pl.BlockSpec((1, 1, block_q, g), lambda bi, gi, i: (bi, gi, i, 0))
+        return pl.pallas_call(
+            functools.partial(
+                _fwd_kernel_packed, block_q=block_q, block_kv=t, g=g, d=d, scale=scale
+            ),
+            grid=(b, hg, nq),
+            in_specs=[dspec, kvspec, kvspec],
+            out_specs=[dspec, lsespec],
+            out_shape=[
+                jax.ShapeDtypeStruct((b, t, hd), q.dtype),
+                jax.ShapeDtypeStruct((b, hg, t, g), jnp.float32),
+            ],
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=("parallel", "parallel", "parallel"),
+            ),
+            interpret=_interpret(),
+        )(q, k, v)
+    nkv = t // block_kv
+    qspec = pl.BlockSpec((1, block_q, _LANES), lambda bi, gi, i, j: (bi, i, gi))
+    kvspec = pl.BlockSpec((1, block_kv, _LANES), lambda bi, gi, i, j: (bi, j, gi))
+    lsespec = pl.BlockSpec((1, 1, block_q, g), lambda bi, gi, i, j: (bi, gi, i, 0))
     return pl.pallas_call(
         functools.partial(
-            _fwd_kernel_packed, block_q=block_q, block_kv=t, g=g, d=d, scale=scale
+            _fwd_kernel_packed_multi,
+            block_q=block_q, block_kv=block_kv, g=g, d=d, scale=scale,
         ),
-        grid=(b, hg, nq),
-        in_specs=[dspec, kvspec, kvspec],
-        out_specs=[dspec, lsespec],
+        grid=(b, hg, nq, nkv),
+        in_specs=[qspec, kvspec, kvspec],
+        out_specs=[qspec, lsespec],
         out_shape=[
             jax.ShapeDtypeStruct((b, t, hd), q.dtype),
             jax.ShapeDtypeStruct((b, hg, t, g), jnp.float32),
         ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, _LANES), jnp.float32),  # m
+            pltpu.VMEM((block_q, _LANES), jnp.float32),  # l
+            pltpu.VMEM((block_q, _LANES), jnp.float32),  # acc (g head slices)
+        ],
         compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel", "parallel"),
+            dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
         ),
         interpret=_interpret(),
     )(q, k, v)
 
 
-def _packed_flash_fwd(q, k, v, block_q, g, d, scale):
-    out, lse = _packed_fwd_call(q, k, v, block_q, g, d, scale)
+def _packed_flash_fwd(q, k, v, block_q, block_kv, g, d, scale):
+    out, lse = _packed_fwd_call(q, k, v, block_q, block_kv, g, d, scale)
     return out, (q, k, v, out, lse)
 
 
-def _packed_flash_bwd(block_q, g, d, scale, res, do):
+def _packed_flash_bwd(block_q, block_kv, g, d, scale, res, do):
     q, k, v, out, lse = res
     b, t, hd = q.shape
     hg = hd // _LANES
     nq = t // block_q
-    dspec, kvspec = _packed_specs(t, block_q)
-    lsespec = pl.BlockSpec((1, 1, block_q, g), lambda bi, gi, i: (bi, gi, i, 0))
+    if block_kv == t and nq == 1:
+        dspec, kvspec = _packed_specs(t, block_q)
+        lsespec = pl.BlockSpec((1, 1, block_q, g), lambda bi, gi, i: (bi, gi, i, 0))
+        dq, dk, dv = pl.pallas_call(
+            functools.partial(
+                _bwd_kernel_packed, block_q=block_q, block_kv=t, g=g, d=d, scale=scale
+            ),
+            grid=(b, hg, nq),
+            in_specs=[dspec, kvspec, kvspec, dspec, dspec, lsespec],
+            out_specs=[dspec, kvspec, kvspec],
+            out_shape=[
+                jax.ShapeDtypeStruct((b, t, hd), q.dtype),
+                jax.ShapeDtypeStruct((b, t, hd), k.dtype),
+                jax.ShapeDtypeStruct((b, t, hd), v.dtype),
+            ],
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=("parallel", "parallel", "parallel"),
+            ),
+            interpret=_interpret(),
+        )(q, k, v, do, out, lse)
+        return dq, dk, dv
+    nkv = t // block_kv
+    qspec = pl.BlockSpec((1, block_q, _LANES), lambda bi, gi, i, j: (bi, i, gi))
+    kvspec = pl.BlockSpec((1, block_kv, _LANES), lambda bi, gi, i, j: (bi, j, gi))
+    lsespec = pl.BlockSpec((1, 1, block_q, g), lambda bi, gi, i, j: (bi, gi, i, 0))
+    fullspec = pl.BlockSpec((1, t, _LANES), lambda bi, gi, i, j: (bi, 0, gi))
     dq, dk, dv = pl.pallas_call(
         functools.partial(
-            _bwd_kernel_packed, block_q=block_q, block_kv=t, g=g, d=d, scale=scale
+            _bwd_kernel_packed_multi,
+            block_q=block_q, block_kv=block_kv, g=g, d=d, scale=scale,
         ),
-        grid=(b, hg, nq),
-        in_specs=[dspec, kvspec, kvspec, dspec, dspec, lsespec],
-        out_specs=[dspec, kvspec, kvspec],
+        grid=(b, hg, nq, nkv),
+        in_specs=[qspec, kvspec, kvspec, qspec, qspec, lsespec],
+        out_specs=[qspec, fullspec, fullspec],
         out_shape=[
             jax.ShapeDtypeStruct((b, t, hd), q.dtype),
             jax.ShapeDtypeStruct((b, t, hd), k.dtype),
             jax.ShapeDtypeStruct((b, t, hd), v.dtype),
         ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, _LANES), jnp.float32),  # dq accumulator
+            pltpu.VMEM((t, _LANES), jnp.float32),        # dk accumulator
+            pltpu.VMEM((t, _LANES), jnp.float32),        # dv accumulator
+            pltpu.VMEM((block_q, _LANES), jnp.float32),  # delta (per q block)
+        ],
         compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel", "parallel"),
+            dimension_semantics=("parallel", "parallel", "arbitrary", "arbitrary"),
         ),
         interpret=_interpret(),
     )(q, k, v, do, out, lse)
@@ -582,14 +768,16 @@ def flash_causal_attention(
         )
 
     g = _packed_group(d, h)
-    if g is not None and t == block_q and t == block_kv:
-        # Packed transpose-free path: whole KV in one tile and heads group
-        # into 128-lane blocks -> operate on the model-native (B, T, H*D)
-        # layout directly. reshape is a bitcast; no HBM relayout anywhere.
+    if g is not None:
+        # Packed transpose-free path: heads group into 128-lane blocks ->
+        # operate on the model-native (B, T, H*D) layout directly. reshape
+        # is a bitcast; no HBM relayout anywhere. Single-tile shapes use
+        # the one-pass kernels; tiled shapes the online-softmax/causal-
+        # block-skipping ones.
         scale = float(d ** -0.5)
         out = _flash_packed(
             q.reshape(b, t, h * d), k.reshape(b, t, h * d),
-            v.reshape(b, t, h * d), block_q, g, d, scale,
+            v.reshape(b, t, h * d), block_q, block_kv, g, d, scale,
         )
         return out.reshape(b, t, h, d)
 

@@ -28,7 +28,12 @@ def create_optimizer(cfg: OptimConfig, total_steps: int = 0) -> optax.GradientTr
         )
     else:  # pragma: no cover - schema validates
         raise ValueError(cfg.schedule)
+    clip = (
+        optax.clip_by_global_norm(cfg.grad_clip)
+        if cfg.grad_clip > 0
+        else optax.identity()
+    )
     return optax.chain(
-        optax.clip_by_global_norm(cfg.grad_clip),
+        clip,
         optax.adamw(learning_rate=lr, b1=cfg.b1, b2=cfg.b2, weight_decay=cfg.weight_decay),
     )

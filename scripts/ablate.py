@@ -1,0 +1,19 @@
+"""Quick step-time ablations on the real chip: where do the non-matmul
+milliseconds go? Each variant times the b32+remat flagship step with one
+component altered. Usage: python scripts/ablate.py"""
+
+from __future__ import annotations
+
+from bench_common import time_step
+
+if __name__ == "__main__":
+    base = time_step()
+    print(f"baseline b32 remat:        {base:7.2f} ms")
+    v = time_step(dropout=0.0)
+    print(f"dropout=0:                 {v:7.2f} ms  (delta {base - v:+.2f})")
+    v = time_step(grad_clip=0.0)
+    print(f"no grad clip:              {v:7.2f} ms  (delta {base - v:+.2f})")
+    v = time_step(weight_decay=0.0)
+    print(f"no weight decay:           {v:7.2f} ms  (delta {base - v:+.2f})")
+    v = time_step(remat=False)
+    print(f"no remat:                  {v:7.2f} ms  (delta {base - v:+.2f})")

@@ -1,0 +1,79 @@
+"""Shared flagship-step benchmark harness for scripts/{ablate,profile_step}.py.
+
+One place defines the flagship model/optimizer shapes and the
+warmup + timed-loop protocol, so the ablation and the profiler always
+measure the same program.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+
+def build_step(batch=32, heads=16, max_seq_len=512, dropout=0.1, remat=True,
+               grad_clip=1.0, weight_decay=0.1):
+    """Returns (step_fn, state, batch_obj, key, mesh_ctx) for the flagship
+    GPT-89.6M train step with the given knobs."""
+    import jax
+    import jax.numpy as jnp
+    from flax import linen as nn
+
+    from dtc_tpu.config.schema import MeshConfig, ModelConfig, OptimConfig, TrainConfig
+    from dtc_tpu.data.synthetic import synthetic_batch_iterator
+    from dtc_tpu.models.gpt import GPT
+    from dtc_tpu.parallel.mesh import mesh_from_config
+    from dtc_tpu.parallel.sharding import DEFAULT_RULES
+    from dtc_tpu.train.train_step import Batch, create_train_step
+    from dtc_tpu.train.trainer import init_state
+
+    model_cfg = ModelConfig(
+        vocab_size=50258, d_model=512, n_layers=12, n_heads=heads, d_ff=2048,
+        max_seq_len=max_seq_len, dropout=dropout, param_dtype="float32",
+        compute_dtype="bfloat16", attention="auto", remat=remat,
+    )
+    opt_cfg = OptimConfig(lr=3e-4, weight_decay=weight_decay, grad_clip=grad_clip)
+    train_cfg = TrainConfig(
+        seed=0, parallel="dp", batch=batch, steps=1, log_every=1, output_dir="",
+        dataset="synthetic", warmup_steps=0, prefetch=0, mesh=MeshConfig(),
+    )
+    mesh = mesh_from_config("dp", train_cfg.mesh)
+    model = GPT(model_cfg)
+    with mesh, nn.logical_axis_rules(DEFAULT_RULES):
+        state = init_state(model, model_cfg, train_cfg, opt_cfg, mesh, DEFAULT_RULES)
+        step_fn = create_train_step(mesh, model=model)
+    tok = next(synthetic_batch_iterator(batch, max_seq_len + 1, model_cfg.vocab_size))
+    batch_obj = Batch(x=jnp.asarray(tok[:, :-1]), y=jnp.asarray(tok[:, 1:]))
+    key = jax.random.key(0, impl="rbg")
+    return step_fn, state, batch_obj, key, (mesh, DEFAULT_RULES), model_cfg
+
+
+def time_step(steps=20, warmup=6, trace_dir=None, **knobs) -> float:
+    """Warmup + timed loop; returns ms/step. Sync is by value fetch — on
+    tunneled platforms block_until_ready can return before device work
+    completes, a host transfer cannot. ``trace_dir`` wraps ``steps`` traced
+    iterations (used by profile_step) before the timed loop."""
+    import jax
+    import numpy as np
+    from flax import linen as nn
+
+    step_fn, state, batch, key, (mesh, rules), _ = build_step(**knobs)
+    with mesh, nn.logical_axis_rules(rules):
+        for i in range(warmup):
+            state, loss = step_fn(state, batch, jax.random.fold_in(key, i))
+        float(np.asarray(loss))
+        if trace_dir is not None:
+            with jax.profiler.trace(trace_dir):
+                for i in range(steps):
+                    state, loss = step_fn(state, batch, jax.random.fold_in(key, 100 + i))
+                float(np.asarray(loss))
+        t0 = time.perf_counter()
+        for i in range(steps):
+            state, loss = step_fn(state, batch, jax.random.fold_in(key, 200 + i))
+        float(np.asarray(loss))
+        return (time.perf_counter() - t0) / steps * 1e3

@@ -19,51 +19,12 @@ sys.path.insert(0, REPO)
 
 
 def run(batch: int, heads: int, steps: int, trace_dir: str, remat: bool) -> float:
-    import time
+    from bench_common import time_step
 
-    import jax
-    import jax.numpy as jnp
-    import numpy as np
-    from flax import linen as nn
-
-    from dtc_tpu.config.schema import MeshConfig, ModelConfig, OptimConfig, TrainConfig
-    from dtc_tpu.data.synthetic import synthetic_batch_iterator
-    from dtc_tpu.models.gpt import GPT
-    from dtc_tpu.parallel.mesh import mesh_from_config
-    from dtc_tpu.parallel.sharding import DEFAULT_RULES
-    from dtc_tpu.train.train_step import Batch, create_train_step
-    from dtc_tpu.train.trainer import init_state
-
-    model_cfg = ModelConfig(
-        vocab_size=50258, d_model=512, n_layers=12, n_heads=heads, d_ff=2048,
-        max_seq_len=512, dropout=0.1, param_dtype="float32",
-        compute_dtype="bfloat16", attention="auto", remat=remat,
+    return time_step(
+        steps=steps, trace_dir=trace_dir,
+        batch=batch, heads=heads, remat=remat,
     )
-    opt_cfg = OptimConfig(lr=3e-4, weight_decay=0.1, grad_clip=1.0)
-    train_cfg = TrainConfig(
-        seed=0, parallel="dp", batch=batch, steps=1, log_every=1, output_dir="",
-        dataset="synthetic", warmup_steps=0, prefetch=0, mesh=MeshConfig(),
-    )
-    mesh = mesh_from_config("dp", train_cfg.mesh)
-    model = GPT(model_cfg)
-    with mesh, nn.logical_axis_rules(DEFAULT_RULES):
-        state = init_state(model, model_cfg, train_cfg, opt_cfg, mesh, DEFAULT_RULES)
-        step_fn = create_train_step(mesh, model=model)
-        tok = next(synthetic_batch_iterator(batch, 513, model_cfg.vocab_size))
-        x, y = jnp.asarray(tok[:, :-1]), jnp.asarray(tok[:, 1:])
-        key = jax.random.key(0, impl="rbg")
-        for i in range(5):
-            state, loss = step_fn(state, Batch(x=x, y=y), jax.random.fold_in(key, i))
-        float(np.asarray(loss))
-        with jax.profiler.trace(trace_dir):
-            for i in range(steps):
-                state, loss = step_fn(state, Batch(x=x, y=y), jax.random.fold_in(key, 10 + i))
-            float(np.asarray(loss))
-        t0 = time.perf_counter()
-        for i in range(20):
-            state, loss = step_fn(state, Batch(x=x, y=y), jax.random.fold_in(key, 40 + i))
-        float(np.asarray(loss))
-        return (time.perf_counter() - t0) / 20
 
 
 def parse(trace_dir: str, steps: int, top: int):
@@ -105,6 +66,6 @@ if __name__ == "__main__":
     ap.add_argument("--no-remat", action="store_true")
     ap.add_argument("--trace-dir", default="/tmp/dtc_trace")
     args = ap.parse_args()
-    step_time = run(args.batch, args.heads, args.steps, args.trace_dir, not args.no_remat)
-    print(f"# measured step time: {step_time * 1e3:.2f} ms")
+    step_ms = run(args.batch, args.heads, args.steps, args.trace_dir, not args.no_remat)
+    print(f"# measured step time: {step_ms:.2f} ms")
     parse(args.trace_dir, args.steps, args.top)

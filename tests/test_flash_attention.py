@@ -135,11 +135,38 @@ def test_packed_group_predicate():
     assert _packed_group(256, 4) is None  # head_dim wider than the lane block
 
 
-def test_packed_matches_unpacked_kernel():
-    """Same shape through BOTH code paths: block_q = t engages the packed
-    single-tile kernels, block_q = t // 2 forces the transpose/multi-tile
-    path. Their outputs must agree to fp32 accumulation noise."""
+def test_packed_single_matches_packed_multi():
+    """Same shape through both packed kernels: block_q = t engages the
+    one-pass single-tile path, block_q = t // 2 the online-softmax
+    causal-block-skipping path. Outputs agree to fp32 accumulation noise."""
     q, k, v = _qkv(jax.random.PRNGKey(5), 2, 256, 8, 32)
-    packed = flash_causal_attention(q, k, v, block_q=256, block_kv=256)
-    unpacked = flash_causal_attention(q, k, v, block_q=128, block_kv=128)
-    np.testing.assert_allclose(np.asarray(packed), np.asarray(unpacked), atol=2e-5)
+    single = flash_causal_attention(q, k, v, block_q=256, block_kv=256)
+    multi = flash_causal_attention(q, k, v, block_q=128, block_kv=128)
+    np.testing.assert_allclose(np.asarray(single), np.asarray(multi), atol=2e-5)
+
+
+@pytest.mark.parametrize("bq,bkv", [(128, 128), (256, 256), (128, 256)])
+def test_packed_multi_tile_parity(bq, bkv):
+    """Packed multi-tile (online softmax + causal block skip) vs dense."""
+    t, d, h = 512, 32, 8
+    q, k, v = _qkv(jax.random.PRNGKey(6), 2, t, h, d)
+    got = flash_causal_attention(q, k, v, block_q=bq, block_kv=bkv)
+    ref = dense_causal_attention(q, k, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=2e-5)
+
+
+def test_packed_multi_tile_grad_parity():
+    t, d, h = 256, 32, 8
+    q, k, v = _qkv(jax.random.PRNGKey(7), 2, t, h, d)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_causal_attention(q, k, v, block_q=128, block_kv=128) ** 2)
+
+    def loss_dense(q, k, v):
+        return jnp.sum(dense_causal_attention(q, k, v) ** 2)
+
+    g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_dense = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for name, a, b in zip("qkv", g_dense, g_flash):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a), atol=2e-4,
+                                   err_msg=f"d{name}")
