@@ -41,6 +41,13 @@ from jax.experimental.pallas import tpu as pltpu
 NEG_INF = -1e9  # matches the reference's additive mask value (ops/attention.py)
 _LANES = 128  # TPU lane width (kept for stat-scratch shapes)
 
+#: Longest sequence routed to the packed kernels. The packed multi-tile
+#: BACKWARD accumulates dk/dv in full-T (T, 128) fp32 VMEM scratches —
+#: ~8 MB of scratch + output blocks at T=4096 (measured working on a v5e);
+#: doubling T again exceeds a core's VMEM, so longer sequences fall back to
+#: the transpose-layout kernels whose scratch is O(block), not O(T).
+_PACKED_MAX_T = 4096
+
 
 def _interpret() -> bool:
     return jax.default_backend() != "tpu"
@@ -768,12 +775,14 @@ def flash_causal_attention(
         )
 
     g = _packed_group(d, h)
-    if g is not None:
+    if g is not None and t <= _PACKED_MAX_T:
         # Packed transpose-free path: heads group into 128-lane blocks ->
         # operate on the model-native (B, T, H*D) layout directly. reshape
         # is a bitcast; no HBM relayout anywhere. Single-tile shapes use
         # the one-pass kernels; tiled shapes the online-softmax/causal-
-        # block-skipping ones.
+        # block-skipping ones. Beyond _PACKED_MAX_T the tiled backward's
+        # full-T dk/dv scratches outgrow VMEM and the transpose path (all
+        # scratch O(block)) takes over.
         scale = float(d ** -0.5)
         out = _flash_packed(
             q.reshape(b, t, h * d), k.reshape(b, t, h * d),

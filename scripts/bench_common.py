@@ -18,8 +18,8 @@ if REPO not in sys.path:
 
 def build_step(batch=32, heads=16, max_seq_len=512, dropout=0.1, remat=True,
                grad_clip=1.0, weight_decay=0.1):
-    """Returns (step_fn, state, batch_obj, key, mesh_ctx) for the flagship
-    GPT-89.6M train step with the given knobs."""
+    """Returns (step_fn, state, batch_obj, key, (mesh, rules), model_cfg)
+    for the flagship GPT-89.6M train step with the given knobs."""
     import jax
     import jax.numpy as jnp
     from flax import linen as nn
@@ -53,11 +53,13 @@ def build_step(batch=32, heads=16, max_seq_len=512, dropout=0.1, remat=True,
     return step_fn, state, batch_obj, key, (mesh, DEFAULT_RULES), model_cfg
 
 
-def time_step(steps=20, warmup=6, trace_dir=None, **knobs) -> float:
+def time_step(steps=20, warmup=6, trace_dir=None, trace_steps=6, **knobs) -> float:
     """Warmup + timed loop; returns ms/step. Sync is by value fetch — on
     tunneled platforms block_until_ready can return before device work
-    completes, a host transfer cannot. ``trace_dir`` wraps ``steps`` traced
-    iterations (used by profile_step) before the timed loop."""
+    completes, a host transfer cannot. ``trace_dir`` wraps ``trace_steps``
+    traced iterations (used by profile_step) before the ``steps``-iteration
+    timed loop — tracing few steps keeps the trace small without shortening
+    the timing protocol."""
     import jax
     import numpy as np
     from flax import linen as nn
@@ -69,7 +71,7 @@ def time_step(steps=20, warmup=6, trace_dir=None, **knobs) -> float:
         float(np.asarray(loss))
         if trace_dir is not None:
             with jax.profiler.trace(trace_dir):
-                for i in range(steps):
+                for i in range(trace_steps):
                     state, loss = step_fn(state, batch, jax.random.fold_in(key, 100 + i))
                 float(np.asarray(loss))
         t0 = time.perf_counter()

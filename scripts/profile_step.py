@@ -21,8 +21,10 @@ sys.path.insert(0, REPO)
 def run(batch: int, heads: int, steps: int, trace_dir: str, remat: bool) -> float:
     from bench_common import time_step
 
+    # Trace `steps` iterations (trace size), but always time the full
+    # 20-iteration protocol PERF.md numbers use.
     return time_step(
-        steps=steps, trace_dir=trace_dir,
+        steps=20, trace_dir=trace_dir, trace_steps=steps,
         batch=batch, heads=heads, remat=remat,
     )
 
