@@ -36,7 +36,20 @@ NEG_INF = -1e9
 
 
 def _ambient_mesh():
-    """The mesh installed by the trainer's ``with mesh:`` context."""
+    """The mesh to hand the inner shard_map.
+
+    Under a jit with an active trace context this is the ABSTRACT mesh —
+    which carries per-axis Manual/Auto state, so ring attention nests
+    correctly inside another manual region (the pipeline's shard_map over
+    "pipe": the abstract mesh there is Manual on pipe, Auto elsewhere, and
+    shard_map requires the passed mesh to match it exactly). Falls back to
+    the physical mesh installed by the trainer's ``with mesh:`` context.
+    """
+    from jax.sharding import get_abstract_mesh
+
+    amesh = get_abstract_mesh()
+    if not amesh.empty:
+        return amesh
     from jax._src.mesh import thread_resources
 
     mesh = thread_resources.env.physical_mesh

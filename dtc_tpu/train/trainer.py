@@ -169,13 +169,24 @@ def train(
     mesh = mesh_from_config(
         train_cfg.parallel, train_cfg.mesh, n_layers=model_cfg.n_layers
     )
-    if model_cfg.attention == "ring" and rules is DEFAULT_RULES:
-        # Ring attention repurposes the "model" mesh axis for sequence
-        # parallelism; swap in the rule table that shards seq instead of
-        # the Megatron TP axes (see parallel/sharding.py RING_RULES).
-        from dtc_tpu.parallel.sharding import RING_RULES
+    if model_cfg.attention == "ring":
+        if mesh.shape.get("pipe", 1) > 1:
+            # The ring's inner shard_map over "model" cannot nest inside
+            # the pipeline's manual region (Shardy rejects re-binding a
+            # mesh whose "pipe" axis a parent manual computation owns).
+            # Sequence parallelism composes with DP/TP, not PP.
+            raise ValueError(
+                "attention='ring' (sequence parallelism) cannot run under "
+                "pipeline parallelism; use a mesh with pipe=1 (ring "
+                "composes with the data axis)"
+            )
+        if rules is DEFAULT_RULES:
+            # Ring attention repurposes the "model" mesh axis for sequence
+            # parallelism; swap in the rule table that shards seq instead
+            # of the Megatron TP axes (see parallel/sharding.py RING_RULES).
+            from dtc_tpu.parallel.sharding import RING_RULES
 
-        rules = RING_RULES
+            rules = RING_RULES
     lead = is_lead_process()
     if lead:
         print(

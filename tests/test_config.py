@@ -60,3 +60,22 @@ def test_resolve_mesh_shapes():
     assert resolve_mesh_shape("dp", 8, MeshConfig(model=2)) == (1, 4, 2)
     with pytest.raises(ValueError):
         resolve_mesh_shape("3d", 8, MeshConfig(pipe=2, data=2, model=1))
+
+
+def test_grad_clip_zero_disables_clipping():
+    """grad_clip=0 must mean 'no clipping', not clip-everything-to-zero
+    (optax.clip_by_global_norm(0.0) zeroes all gradients)."""
+    import jax.numpy as jnp
+    import optax
+
+    from dtc_tpu.config.schema import OptimConfig
+    from dtc_tpu.train.optimizer import create_optimizer
+
+    tx = create_optimizer(OptimConfig(lr=1.0, weight_decay=0.0, grad_clip=0.0))
+    params = {"w": jnp.ones(4)}
+    grads = {"w": jnp.full(4, 100.0)}
+    state = tx.init(params)
+    updates, _ = tx.update(grads, state, params)
+    # Adam normalizes: update magnitude ~lr regardless, but with clip(0.0)
+    # the update would be exactly zero.
+    assert float(jnp.abs(updates["w"]).sum()) > 0

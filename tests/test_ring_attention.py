@@ -86,3 +86,17 @@ def test_train_ring_matches_dense(train_cfg_factory, tiny_model_cfg, opt_cfg):
     np.testing.assert_allclose(ring.losses, dense.losses, rtol=2e-4)
     # RING_RULES actually engaged (trainer swaps the table itself).
     assert RING_RULES[[r[0] for r in RING_RULES].index("seq")][1] == "model"
+
+
+def test_ring_under_pipeline_raises_clearly(tiny_model_cfg, opt_cfg, train_cfg_factory):
+    """Ring attention's shard_map over "model" cannot nest inside the
+    pipeline's manual "pipe" region (Shardy rejects the nesting); the
+    trainer must fail with an actionable message, not a lowering error."""
+    import dataclasses
+
+    ring_model = dataclasses.replace(tiny_model_cfg, attention="ring")
+    cfg = train_cfg_factory(
+        "3d", steps=1, pp_microbatches=2, mesh=MeshConfig(pipe=2, data=2, model=2)
+    )
+    with pytest.raises(ValueError, match="pipeline"):
+        train(cfg, ring_model, opt_cfg)
