@@ -1,0 +1,105 @@
+"""Autoregressive generation with a per-layer KV cache.
+
+A capability beyond the reference (which trains and plots, but cannot
+sample — SURVEY.md §1 lists no serve/inference path). Decode reuses the
+training model unchanged: ``decode=True`` threads a "cache" collection
+through the modules — each attention layer keeps ``(B, max_seq_len, H, D)``
+key/value buffers plus a write index, the embed keeps a position counter —
+so one prefill call consumes the whole prompt and each subsequent call
+appends one token at O(T) cost instead of re-running the full O(T²)
+forward per token.
+
+The token loop is a ``lax.scan`` under one ``jax.jit``: no per-token
+Python dispatch, TPU-friendly static shapes throughout.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+PyTree = Any
+
+
+def init_cache(model, batch_size: int) -> PyTree:
+    """Fresh decode cache for ``batch_size`` sequences.
+
+    Shapes come from ``jax.eval_shape`` over the decode init — no params
+    are materialized and no forward runs (``model.init`` would both
+    allocate a full random parameter set AND advance the cache by one
+    position). Every leaf starts at zero: index/pos 0, empty K/V."""
+    dummy = jnp.ones((batch_size, 1), dtype=jnp.int32)
+    shapes = jax.eval_shape(
+        lambda: model.init(
+            {"params": jax.random.PRNGKey(0)}, dummy, train=False, decode=True
+        )
+    )
+    return jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), shapes["cache"])
+
+
+@functools.partial(jax.jit, static_argnums=(0, 3), static_argnames=("temperature",))
+def generate(
+    model,
+    params: PyTree,
+    prompt: jax.Array,
+    max_new_tokens: int,
+    rng: jax.Array | None = None,
+    *,
+    temperature: float = 0.0,
+) -> jax.Array:
+    """Sample ``max_new_tokens`` continuations of ``prompt`` (B, T_prompt).
+
+    ``temperature == 0`` is greedy argmax; otherwise softmax sampling at the
+    given temperature (requires ``rng``). Returns ``(B, max_new_tokens)``
+    int32 tokens. Total length must fit ``cfg.max_seq_len``.
+    """
+    b, t_prompt = prompt.shape
+    cfg = model.cfg
+    if t_prompt + max_new_tokens > cfg.max_seq_len:
+        raise ValueError(
+            f"prompt ({t_prompt}) + max_new_tokens ({max_new_tokens}) exceeds "
+            f"max_seq_len ({cfg.max_seq_len}) — the KV cache cannot grow past it"
+        )
+    if temperature > 0.0 and rng is None:
+        raise ValueError("temperature sampling needs an rng key")
+    if rng is None:
+        rng = jax.random.PRNGKey(0)  # unused by greedy
+
+    def sample(logits_last: jax.Array, key: jax.Array) -> jax.Array:
+        # Padded vocab columns carry -1e9 from the head mask, so neither
+        # argmax nor categorical can pick them.
+        if temperature == 0.0:
+            return jnp.argmax(logits_last, axis=-1).astype(jnp.int32)
+        return jax.random.categorical(
+            key, logits_last.astype(jnp.float32) / temperature, axis=-1
+        ).astype(jnp.int32)
+
+    cache = init_cache(model, b)
+
+    # Prefill: one forward over the whole prompt fills every layer's cache.
+    logits, mutated = model.apply(
+        {"params": params, "cache": cache}, prompt,
+        train=False, decode=True, mutable=["cache"],
+    )
+    rng, sub = jax.random.split(rng)
+    first = sample(logits[:, -1], sub)
+
+    def body(carry, _):
+        cache, tok, key = carry
+        logits, mutated = model.apply(
+            {"params": params, "cache": cache}, tok[:, None],
+            train=False, decode=True, mutable=["cache"],
+        )
+        key, sub = jax.random.split(key)
+        nxt = sample(logits[:, -1], sub)
+        return (mutated["cache"], nxt, key), nxt
+
+    if max_new_tokens == 1:
+        return first[:, None]
+    (_, _, _), rest = jax.lax.scan(
+        body, (mutated["cache"], first, rng), None, length=max_new_tokens - 1
+    )
+    return jnp.concatenate([first[:, None], rest.T], axis=1)

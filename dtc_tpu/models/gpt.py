@@ -47,7 +47,7 @@ class CausalSelfAttention(nn.Module):
     cfg: ModelConfig
 
     @nn.compact
-    def __call__(self, x: jax.Array, *, train: bool) -> jax.Array:
+    def __call__(self, x: jax.Array, *, train: bool, decode: bool = False) -> jax.Array:
         cfg = self.cfg
         b, t, _ = x.shape
         cdtype = _dtype(cfg.compute_dtype)
@@ -59,19 +59,48 @@ class CausalSelfAttention(nn.Module):
         q = dense("q_proj")(x).reshape(b, t, cfg.n_heads, cfg.head_dim)
         k = dense("k_proj")(x).reshape(b, t, cfg.n_heads, cfg.head_dim)
         v = dense("v_proj")(x).reshape(b, t, cfg.n_heads, cfg.head_dim)
-        # Head axis is the TP-sharded axis: under TP each device holds
-        # n_heads / model_parallelism heads and attention is embarrassingly
-        # parallel until out_proj's row-parallel all-reduce.
-        q = nn.with_logical_constraint(q, ("batch", "seq", "heads", "head_dim"))
-        k = nn.with_logical_constraint(k, ("batch", "seq", "heads", "head_dim"))
-        v = nn.with_logical_constraint(v, ("batch", "seq", "heads", "head_dim"))
 
-        out = causal_attention(
-            q, k, v,
-            impl=cfg.attention,
-            block_q=cfg.attention_block_q,
-            block_kv=cfg.attention_block_kv,
-        )
+        if decode:
+            # Autoregressive KV-cache path (inference; single device or
+            # GSPMD — no flash/ring). The cache holds max_seq_len k/v per
+            # layer; ``index`` is the write frontier shared with the
+            # embed's position counter by construction (both advance by t
+            # per call). CALLER CONTRACT: total decoded length must stay
+            # <= max_seq_len — past it, dynamic_update_slice CLAMPS the
+            # write start and logits go silently wrong (the index is
+            # traced, so this cannot raise here; dtc_tpu.generate.generate
+            # enforces the bound at its static API surface).
+            from dtc_tpu.ops.attention import decode_attention
+
+            ck = self.variable(
+                "cache", "k", jnp.zeros,
+                (b, cfg.max_seq_len, cfg.n_heads, cfg.head_dim), cdtype,
+            )
+            cv = self.variable(
+                "cache", "v", jnp.zeros,
+                (b, cfg.max_seq_len, cfg.n_heads, cfg.head_dim), cdtype,
+            )
+            ci = self.variable("cache", "index", lambda: jnp.zeros((), jnp.int32))
+            idx = ci.value
+            ck.value = jax.lax.dynamic_update_slice(ck.value, k, (0, idx, 0, 0))
+            cv.value = jax.lax.dynamic_update_slice(cv.value, v, (0, idx, 0, 0))
+            ci.value = idx + t
+            out = decode_attention(q, ck.value, cv.value, idx)
+        else:
+            # Head axis is the TP-sharded axis: under TP each device holds
+            # n_heads / model_parallelism heads and attention is
+            # embarrassingly parallel until out_proj's row-parallel
+            # all-reduce.
+            q = nn.with_logical_constraint(q, ("batch", "seq", "heads", "head_dim"))
+            k = nn.with_logical_constraint(k, ("batch", "seq", "heads", "head_dim"))
+            v = nn.with_logical_constraint(v, ("batch", "seq", "heads", "head_dim"))
+
+            out = causal_attention(
+                q, k, v,
+                impl=cfg.attention,
+                block_q=cfg.attention_block_q,
+                block_kv=cfg.attention_block_kv,
+            )
         out = out.reshape(b, t, cfg.d_model)
         out = dense("out_proj")(out)
         # Row-parallel output: constraining back to embed-replicated makes
@@ -102,7 +131,7 @@ class Block(nn.Module):
     cfg: ModelConfig
 
     @nn.compact
-    def __call__(self, x: jax.Array, *, train: bool) -> jax.Array:
+    def __call__(self, x: jax.Array, *, train: bool, decode: bool = False) -> jax.Array:
         cfg = self.cfg
 
         def ln(name):
@@ -111,7 +140,7 @@ class Block(nn.Module):
 
         h = ln("ln_1")(x).astype(_dtype(cfg.compute_dtype))
         x = x + nn.Dropout(cfg.dropout, deterministic=not train)(
-            CausalSelfAttention(cfg, name="attn")(h, train=train)
+            CausalSelfAttention(cfg, name="attn")(h, train=train, decode=decode)
         )
         h = ln("ln_2")(x).astype(_dtype(cfg.compute_dtype))
         x = x + nn.Dropout(cfg.dropout, deterministic=not train)(MLP(cfg, name="mlp")(h))
@@ -123,10 +152,11 @@ class _ScanBlock(nn.Module):
 
     cfg: ModelConfig
     train: bool
+    decode: bool = False
 
     @nn.compact
     def __call__(self, h: jax.Array, _):
-        return Block(self.cfg)(h, train=self.train), None
+        return Block(self.cfg)(h, train=self.train, decode=self.decode), None
 
 
 class GPTEmbed(nn.Module):
@@ -144,11 +174,23 @@ class GPTEmbed(nn.Module):
 
     @nn.compact
     def __call__(
-        self, x: jax.Array, *, train: bool = True, pos_offset: int | jax.Array = 0
+        self,
+        x: jax.Array,
+        *,
+        train: bool = True,
+        pos_offset: int | jax.Array = 0,
+        decode: bool = False,
     ) -> jax.Array:
         cfg = self.cfg
         pdtype = _dtype(cfg.param_dtype)
         _, t = x.shape
+        if decode:
+            # Position counter for autoregressive decode; advances in step
+            # with the attention layers' cache indices (both add t per
+            # call), so positions line up with cache slots.
+            pos_var = self.variable("cache", "pos", lambda: jnp.zeros((), jnp.int32))
+            pos_offset = pos_var.value
+            pos_var.value = pos_offset + t
         wte = nn.Embed(cfg.padded_vocab_size, cfg.d_model, name="wte", param_dtype=pdtype)
         if self.lookup == "onehot":
             onehot = jax.nn.one_hot(x, cfg.padded_vocab_size, dtype=_dtype(cfg.compute_dtype))
@@ -180,17 +222,19 @@ class GPTStage(nn.Module):
     n_layers: int
 
     @nn.compact
-    def __call__(self, h: jax.Array, *, train: bool = True) -> jax.Array:
+    def __call__(
+        self, h: jax.Array, *, train: bool = True, decode: bool = False
+    ) -> jax.Array:
         cls = _ScanBlock
-        if self.cfg.remat:
+        if self.cfg.remat and not decode:
             cls = nn.remat(cls, prevent_cse=False)
         scanned = nn.scan(
             cls,
-            variable_axes={"params": 0},
+            variable_axes={"params": 0, "cache": 0},
             split_rngs={"params": True, "dropout": True},
             length=self.n_layers,
             metadata_params={nn.PARTITION_NAME: "layers"},
-        )(self.cfg, train, name="blocks")
+        )(self.cfg, train, decode, name="blocks")
         h, _ = scanned(h, None)
         return h
 
@@ -229,9 +273,11 @@ class GPT(nn.Module):
         self.stage = GPTStage(self.cfg, self.cfg.n_layers)
         self.head = GPTHead(self.cfg)
 
-    def __call__(self, x: jax.Array, *, train: bool = True) -> jax.Array:
-        h = self.embed(x, train=train)
-        h = self.stage(h, train=train)
+    def __call__(
+        self, x: jax.Array, *, train: bool = True, decode: bool = False
+    ) -> jax.Array:
+        h = self.embed(x, train=train, decode=decode)
+        h = self.stage(h, train=train, decode=decode)
         return self.head(h)
 
 

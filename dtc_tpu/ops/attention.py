@@ -37,15 +37,32 @@ def dense_causal_attention(q: jax.Array, k: jax.Array, v: jax.Array) -> jax.Arra
 
     Args are ``(B, T, H, D)``. Scores and softmax run in float32 regardless
     of input dtype (bf16-safe); output is cast back to the input dtype.
+    Exactly :func:`decode_attention` with a zero offset and full-length
+    keys — ONE masked-softmax core serves both training and decode, so
+    their numerics cannot drift apart.
     """
+    return decode_attention(q, k, v, jnp.int32(0))
+
+
+def decode_attention(
+    q: jax.Array, k: jax.Array, v: jax.Array, start: jax.Array
+) -> jax.Array:
+    """Attention for KV-cache decode: ``q`` is ``(B, T_new, H, D)`` for the
+    tokens being appended at position ``start``; ``k``/``v`` are the FULL
+    cache ``(B, S, H, D)`` (valid through ``start + T_new``). Causality:
+    query row r (global position start + r) sees cache columns
+    ``col <= start + r``; columns beyond the write frontier are masked the
+    same way. fp32 scores/softmax, same -1e9 semantics as training."""
     b, t, h, d = q.shape
+    s = k.shape[1]
     scale = d ** -0.5
     scores = jnp.einsum(
         "bthd,bshd->bhts", q, k, preferred_element_type=jnp.float32
     ) * scale
-    tpos = jax.lax.broadcasted_iota(jnp.int32, (t, t), 0)
-    spos = jax.lax.broadcasted_iota(jnp.int32, (t, t), 1)
-    scores = jnp.where(spos <= tpos, scores, NEG_INF)
+    row = jax.lax.broadcasted_iota(jnp.int32, (t, s), 0)
+    col = jax.lax.broadcasted_iota(jnp.int32, (t, s), 1)
+    mask = col <= start + row
+    scores = jnp.where(mask[None, None], scores, NEG_INF)
     weights = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
     out = jnp.einsum("bhts,bshd->bthd", weights.astype(v.dtype), v)
     return out.astype(q.dtype)
