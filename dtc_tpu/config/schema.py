@@ -16,7 +16,7 @@ from typing import Any
 
 PyTree = Any
 
-VALID_PARALLEL = ("none", "dp", "tp", "pp", "3d")
+VALID_PARALLEL = ("none", "dp", "tp", "pp", "3d", "fsdp")
 
 
 @dataclass(frozen=True)

@@ -65,7 +65,10 @@ def resolve_mesh_shape(
     checked against the total, not just the ICI part.
     """
     sizes = {PIPE: mesh.pipe, DATA: mesh.data, MODEL: mesh.model}
-    primary = {"dp": DATA, "tp": MODEL, "pp": PIPE, "none": DATA, "3d": None}[parallel]
+    primary = {
+        "dp": DATA, "tp": MODEL, "pp": PIPE, "none": DATA, "3d": None,
+        "fsdp": DATA,  # FSDP shards params over the same axis as the batch
+    }[parallel]
 
     if parallel == "3d":
         # 3D requires explicit sizes; default unset axes to 1.

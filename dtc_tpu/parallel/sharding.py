@@ -41,7 +41,8 @@ DEFAULT_RULES: tuple[tuple[str, str | None], ...] = (
     ("qkv", "model"),         # column-parallel projection outputs
     ("mlp", "model"),         # column-parallel MLP hidden
     ("vocab_out", "model"),   # vocab-parallel lm_head
-    ("embed", None),          # d_model axis
+    ("embed", None),          # d_model axis (activations)
+    ("embed_p", None),        # d_model axis of PARAMS (FSDP shards this)
     ("seq", None),            # sequence axis (ring attention remaps this)
     ("head_dim", None),
     ("layers", None),         # scan-over-layers axis (PP reshapes it, see pipeline.py)
@@ -51,17 +52,37 @@ DEFAULT_RULES: tuple[tuple[str, str | None], ...] = (
     ("microbatch", None),     # leading microbatch axis of PP inputs
 )
 
-#: Rules for ring-attention / sequence parallelism: the sequence axis of
-#: activations is sharded over "model" and KV blocks rotate via ppermute
-#: (ops/ring_attention.py). The "model" mesh axis then carries SEQUENCE
-#: parallelism, so the Megatron TP mappings (heads/qkv/mlp/vocab_out) must
-#: come off it — one mesh axis cannot shard two logical axes of one tensor.
-RING_RULES: tuple[tuple[str, str | None], ...] = tuple(
-    (name, "model") if name == "seq"
-    else (name, None) if name in ("heads", "qkv", "mlp", "vocab_out")
-    else (name, axis)
+#: FSDP / ZeRO-3: every parameter's d_model axis shards over the SAME mesh
+#: axis the batch uses ("data"), so per-device param+optimizer memory drops
+#: by the data-parallel degree. No new collectives are written anywhere:
+#: XLA's partitioner all-gathers each layer's weights at use (inside the
+#: layer scan, so only one layer's worth is ever resident) and the
+#: all-gather's transpose — a reduce-scatter — lands the gradient shards,
+#: which is exactly the ZeRO-3 schedule. Activation axes are untouched.
+FSDP_RULES: tuple[tuple[str, str | None], ...] = tuple(
+    (name, "data") if name == "embed_p" else (name, axis)
     for name, axis in DEFAULT_RULES
 )
+
+def ring_rules_from(
+    rules: tuple[tuple[str, str | None], ...],
+) -> tuple[tuple[str, str | None], ...]:
+    """Derive ring-attention / sequence-parallel rules from any base table:
+    the sequence axis of activations shards over "model" and KV blocks
+    rotate via ppermute (ops/ring_attention.py). The "model" mesh axis then
+    carries SEQUENCE parallelism, so the Megatron TP mappings
+    (heads/qkv/mlp/vocab_out) must come off it — one mesh axis cannot shard
+    two logical axes of one tensor. Everything else (e.g. FSDP's embed_p ->
+    data) passes through, so ring composes with DP and FSDP alike."""
+    return tuple(
+        (name, "model") if name == "seq"
+        else (name, None) if name in ("heads", "qkv", "mlp", "vocab_out")
+        else (name, axis)
+        for name, axis in rules
+    )
+
+
+RING_RULES: tuple[tuple[str, str | None], ...] = ring_rules_from(DEFAULT_RULES)
 
 
 def logical_to_spec(axes: Sequence[str | None], rules: Sequence[tuple[str, str | None]]) -> P:
@@ -93,29 +114,32 @@ def batch_spec(rules: Sequence[tuple[str, str | None]] = DEFAULT_RULES) -> P:
 # --------------------------------------------------------------------------
 
 PARAM_AXES_TABLE: tuple[tuple[tuple[str, ...], tuple[str | None, ...]], ...] = (
-    (("wte", "embedding"), ("vocab_in", "embed")),
-    (("wpe", "embedding"), ("seqpos", "embed")),
-    (("ln_f", "scale"), ("embed",)),
-    (("ln_f", "bias"), ("embed",)),
-    (("lm_head", "kernel"), ("embed", "vocab_out")),
+    # "embed_p" is the d_model axis of PARAMS — distinct from the
+    # activation axis "embed" so FSDP can shard parameter storage without
+    # touching activation layouts (both map to None outside FSDP).
+    (("wte", "embedding"), ("vocab_in", "embed_p")),
+    (("wpe", "embedding"), ("seqpos", "embed_p")),
+    (("ln_f", "scale"), ("embed_p",)),
+    (("ln_f", "bias"), ("embed_p",)),
+    (("lm_head", "kernel"), ("embed_p", "vocab_out")),
     (("lm_head", "bias"), ("vocab_out",)),
     # --- per-block params; leading "layers" axis from nn.scan ---
-    (("ln_1", "scale"), ("layers", "embed")),
-    (("ln_1", "bias"), ("layers", "embed")),
-    (("ln_2", "scale"), ("layers", "embed")),
-    (("ln_2", "bias"), ("layers", "embed")),
-    (("q_proj", "kernel"), ("layers", "embed", "qkv")),
+    (("ln_1", "scale"), ("layers", "embed_p")),
+    (("ln_1", "bias"), ("layers", "embed_p")),
+    (("ln_2", "scale"), ("layers", "embed_p")),
+    (("ln_2", "bias"), ("layers", "embed_p")),
+    (("q_proj", "kernel"), ("layers", "embed_p", "qkv")),
     (("q_proj", "bias"), ("layers", "qkv")),
-    (("k_proj", "kernel"), ("layers", "embed", "qkv")),
+    (("k_proj", "kernel"), ("layers", "embed_p", "qkv")),
     (("k_proj", "bias"), ("layers", "qkv")),
-    (("v_proj", "kernel"), ("layers", "embed", "qkv")),
+    (("v_proj", "kernel"), ("layers", "embed_p", "qkv")),
     (("v_proj", "bias"), ("layers", "qkv")),
-    (("out_proj", "kernel"), ("layers", "qkv", "embed")),
-    (("out_proj", "bias"), ("layers", "embed")),
-    (("fc1", "kernel"), ("layers", "embed", "mlp")),
+    (("out_proj", "kernel"), ("layers", "qkv", "embed_p")),
+    (("out_proj", "bias"), ("layers", "embed_p")),
+    (("fc1", "kernel"), ("layers", "embed_p", "mlp")),
     (("fc1", "bias"), ("layers", "mlp")),
-    (("fc2", "kernel"), ("layers", "mlp", "embed")),
-    (("fc2", "bias"), ("layers", "embed")),
+    (("fc2", "kernel"), ("layers", "mlp", "embed_p")),
+    (("fc2", "bias"), ("layers", "embed_p")),
 )
 
 

@@ -169,6 +169,13 @@ def train(
     mesh = mesh_from_config(
         train_cfg.parallel, train_cfg.mesh, n_layers=model_cfg.n_layers
     )
+    from dtc_tpu.parallel.sharding import FSDP_RULES, ring_rules_from
+
+    caller_rules = rules is not DEFAULT_RULES
+    if train_cfg.parallel == "fsdp" and not caller_rules:
+        # ZeRO-3 parameter sharding: same mesh, same batch layout, but
+        # parameter storage shards over "data" (see sharding.FSDP_RULES).
+        rules = FSDP_RULES
     if model_cfg.attention == "ring":
         if mesh.shape.get("pipe", 1) > 1:
             # The ring's inner shard_map over "model" cannot nest inside
@@ -180,13 +187,12 @@ def train(
                 "pipeline parallelism; use a mesh with pipe=1 (ring "
                 "composes with the data axis)"
             )
-        if rules is DEFAULT_RULES:
+        if not caller_rules:
             # Ring attention repurposes the "model" mesh axis for sequence
-            # parallelism; swap in the rule table that shards seq instead
-            # of the Megatron TP axes (see parallel/sharding.py RING_RULES).
-            from dtc_tpu.parallel.sharding import RING_RULES
-
-            rules = RING_RULES
+            # parallelism: derive the ring table from whatever base is
+            # active (DEFAULT or FSDP), swapping seq onto "model" and the
+            # Megatron TP axes off it.
+            rules = ring_rules_from(rules)
     lead = is_lead_process()
     if lead:
         print(
