@@ -33,11 +33,12 @@ import jax
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_cpu_collectives_implementation", "gloo")
 
-coord, pid, dup = sys.argv[1], int(sys.argv[2]), sys.argv[3] == "dup"
+coord, pid, variant = sys.argv[1], int(sys.argv[2]), sys.argv[3]
 jax.distributed.initialize(coordinator_address=coord, num_processes=2, process_id=pid)
 assert jax.process_count() == 2, jax.process_count()
 assert jax.device_count() == 8, jax.device_count()       # 2 x 4 virtual
 assert jax.local_device_count() == 4
+dup = variant == "dup"
 
 from dtc_tpu.config.schema import MeshConfig, ModelConfig, OptimConfig, TrainConfig
 from dtc_tpu.train.trainer import make_host_iterator, train
@@ -48,10 +49,15 @@ model_cfg = ModelConfig(
     compute_dtype="float32", attention="dense",
 )
 opt_cfg = OptimConfig(lr=1e-3, weight_decay=0.1, grad_clip=1.0)
+# "tp_in_host": the canonical pod layout — tensor parallelism over each
+# process's local devices (fast links), data parallelism across processes
+# (slow links, one gradient all-reduce per step).
+mesh = MeshConfig(model=4, data=2) if variant == "tp_in_host" else MeshConfig()
 train_cfg = TrainConfig(
-    seed=0, parallel="dp", batch=8, steps=3, log_every=1,
+    seed=0, parallel="tp" if variant == "tp_in_host" else "dp",
+    batch=8, steps=3, log_every=1,
     output_dir=os.environ["DTC_OUT"], dataset="synthetic",
-    warmup_steps=0, prefetch=0, mesh=MeshConfig(),
+    warmup_steps=0, prefetch=0, mesh=mesh,
 )
 
 host_it = None
@@ -71,7 +77,7 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def _launch(tmp_path, dup: bool):
+def _launch(tmp_path, variant: str):
     coord = f"127.0.0.1:{_free_port()}"
     procs = []
     for pid in (0, 1):
@@ -84,10 +90,10 @@ def _launch(tmp_path, dup: bool):
         )
         env["JAX_PLATFORMS"] = "cpu"
         env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
-        env["DTC_OUT"] = str(tmp_path / f"variant_dup{dup}")
+        env["DTC_OUT"] = str(tmp_path / f"variant_{variant}")
         procs.append(
             subprocess.Popen(
-                [sys.executable, "-c", WORKER, coord, str(pid), "dup" if dup else "-"],
+                [sys.executable, "-c", WORKER, coord, str(pid), variant],
                 env=env, cwd=REPO,
                 stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
             )
@@ -108,21 +114,33 @@ def _launch(tmp_path, dup: bool):
 
 
 def test_two_process_training(tmp_path):
-    losses = _launch(tmp_path, dup=False)
+    losses = _launch(tmp_path, "dp")
     assert set(losses) == {0, 1}
     # Cross-process gradient sync: both processes see the same global loss.
     np.testing.assert_allclose(losses[0], losses[1], rtol=1e-6)
     assert len(losses[0]) == 3 and all(np.isfinite(losses[0]))
 
     # Lead-only logging: process 0 wrote the CSV; nothing from process 1.
-    out_dir = tmp_path / "variant_dupFalse"
+    out_dir = tmp_path / "variant_dp"
     rows = (out_dir / "log.csv").read_text().strip().splitlines()
     assert len(rows) == 4  # header + 3 steps
 
     # Distinct per-process data: duplicating process-0's stream on both
     # hosts changes the global batch, hence the losses.
-    dup_losses = _launch(tmp_path, dup=True)
+    dup_losses = _launch(tmp_path, "dup")
     np.testing.assert_allclose(dup_losses[0], dup_losses[1], rtol=1e-6)
     assert not np.allclose(losses[0], dup_losses[0], rtol=1e-4), (
         "per-process streams look identical — striding/offsets not applied"
     )
+
+
+def test_two_process_tp_within_host_dp_across(tmp_path):
+    """The canonical pod layout: a (data=2, model=4) mesh where tensor
+    parallelism stays on each process's local devices and data parallelism
+    crosses the process boundary. Exercises cross-process GSPMD collectives
+    beyond the plain gradient all-reduce (activations replicated across
+    hosts, per-layer TP all-reduces local)."""
+    losses = _launch(tmp_path, "tp_in_host")
+    assert set(losses) == {0, 1}
+    np.testing.assert_allclose(losses[0], losses[1], rtol=1e-6)
+    assert len(losses[0]) == 3 and all(np.isfinite(losses[0]))
