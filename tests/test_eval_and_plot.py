@@ -74,3 +74,19 @@ def test_plot_end_to_end(tmp_path):
     plot.main(str(tmp_path))
     assert (tmp_path / "loss.png").exists()
     assert (tmp_path / "average_elapsed_time.png").exists()
+
+
+def test_profiler_window_captures_trace(tiny_model_cfg, opt_cfg, tmp_path):
+    """profile_start/profile_stop capture a trace for exactly that step
+    window (the last public trainer surface without a test)."""
+    import glob
+
+    from dtc_tpu.train.trainer import train
+
+    cfg = make_train_cfg(
+        "dp", steps=4, profile_start=2, profile_stop=4,
+        output_dir=str(tmp_path),
+    )
+    train(cfg, tiny_model_cfg, opt_cfg)
+    traces = glob.glob(str(tmp_path / "profile" / "**" / "*.trace.json.gz"), recursive=True)
+    assert traces, "no trace captured in the configured window"
