@@ -41,6 +41,7 @@ STRATEGIES = {
     "tp": dict(parallel="tp", pp_microbatches=1, mesh={}),
     "pp": dict(parallel="pp", pp_microbatches=4, mesh={}),
     "3d": dict(parallel="3d", pp_microbatches=4, mesh=dict(pipe=2, data=2, model=2)),
+    "fsdp": dict(parallel="fsdp", pp_microbatches=1, mesh={}),
 }
 
 
