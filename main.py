@@ -9,6 +9,8 @@ is mesh shape.
 
 from __future__ import annotations
 
+from dataclasses import replace
+
 import click
 
 from dtc_tpu.config.loader import load_config
@@ -19,10 +21,25 @@ from dtc_tpu.train.trainer import train
 @click.option("--train_config_path", default="configs/train_config_dp.yaml")
 @click.option("--model_config_path", default=None)
 @click.option("--optim_config_path", default=None)
-def main(train_config_path: str, model_config_path: str | None, optim_config_path: str | None):
+@click.option("--steps", type=int, default=None, help="override train steps (smoke runs)")
+@click.option(
+    "--dataset", default=None, type=click.Choice(["fineweb", "synthetic"]),
+    help="override dataset",
+)
+def main(
+    train_config_path: str,
+    model_config_path: str | None,
+    optim_config_path: str | None,
+    steps: int | None,
+    dataset: str | None,
+):
     train_cfg, model_cfg, opt_cfg = load_config(
         train_config_path, model_config_path, optim_config_path
     )
+    if steps is not None:
+        train_cfg = replace(train_cfg, steps=steps)
+    if dataset is not None:
+        train_cfg = replace(train_cfg, dataset=dataset)
 
     # Multi-host init FIRST: jax.distributed.initialize() must run before
     # any backend-touching JAX API (including jax.device_count below).
@@ -33,8 +50,6 @@ def main(train_config_path: str, model_config_path: str | None, optim_config_pat
     if train_cfg.dataset == "fineweb":
         # vocab_size comes from the tokenizer, as in /root/reference/main.py:17-18.
         from dtc_tpu.data.tokenizer import get_tokenizer
-
-        from dataclasses import replace
 
         model_cfg = replace(model_cfg, vocab_size=len(get_tokenizer()))
 

@@ -7,6 +7,12 @@ every multi-device code path (GSPMD DP/TP, shard_map PP, 3D) runs on an
 NOTE: the axon sitecustomize registers the TPU platform at interpreter
 startup and overrides JAX_PLATFORMS, so we must force CPU via
 jax.config.update AFTER import — and XLA_FLAGS before first backend use.
+
+NOTE: tiny test models use compute_dtype=float32, not bfloat16: besides
+tighter parity tolerances, XLA's CPU backend CRASHES (check-fail in
+AllReducePromotion, "Invalid binary instruction opcode copy") compiling
+the pipeline step's bf16 collectives — an upstream XLA CPU bug; the TPU
+backend handles bf16 collectives natively.
 """
 
 import os
