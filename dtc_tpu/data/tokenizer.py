@@ -33,17 +33,39 @@ class ByteTokenizer:
         return bytes(int(i) % 256 for i in ids).decode("utf-8", errors="replace")
 
 
-def get_tokenizer(allow_download: bool | None = None):
+def get_tokenizer(
+    allow_download: bool | None = None, allow_byte_fallback: bool | None = None
+):
     """GPT-2 tokenizer with a <pad> token added (vocab 50258), reference
-    parity with `/root/reference/data/fineweb_edu.py:8-12`; falls back to
-    :class:`ByteTokenizer` when HF files are unavailable offline."""
+    parity with `/root/reference/data/fineweb_edu.py:8-12`.
+
+    If the real tokenizer cannot be loaded this RAISES by default: a byte-level
+    substitute has the right vocab size but entirely different token semantics,
+    so a `dataset: fineweb` run would silently train a different language model.
+    The :class:`ByteTokenizer` fallback is opt-in via ``allow_byte_fallback=True``
+    or ``DTC_ALLOW_BYTE_FALLBACK=1``, and prints a WARNING when taken.
+    """
     if allow_download is None:
         allow_download = os.environ.get("DTC_ALLOW_DOWNLOAD", "0") == "1"
+    if allow_byte_fallback is None:
+        allow_byte_fallback = os.environ.get("DTC_ALLOW_BYTE_FALLBACK", "0") == "1"
     try:
         from transformers import AutoTokenizer
 
         tok = AutoTokenizer.from_pretrained("gpt2", local_files_only=not allow_download)
         tok.add_special_tokens({"pad_token": "<pad>"})
         return tok
-    except Exception:
+    except Exception as e:
+        if not allow_byte_fallback:
+            raise RuntimeError(
+                "Could not load the GPT-2 tokenizer (offline cache miss or "
+                f"download failure: {e!r}). Refusing to silently substitute a "
+                "byte-level tokenizer — it changes training semantics. Set "
+                "DTC_ALLOW_BYTE_FALLBACK=1 (or allow_byte_fallback=True) to "
+                "opt into the ByteTokenizer fallback."
+            ) from e
+        print(
+            "WARNING: GPT-2 tokenizer unavailable; using byte-level fallback "
+            "tokenizer (same vocab size, DIFFERENT token semantics)."
+        )
         return ByteTokenizer()

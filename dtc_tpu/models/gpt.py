@@ -276,6 +276,15 @@ class GPT(nn.Module):
     def __call__(
         self, x: jax.Array, *, train: bool = True, decode: bool = False
     ) -> jax.Array:
+        """Forward pass.
+
+        ``decode=True`` CALLER CONTRACT: the cumulative decoded length across
+        calls must stay <= ``cfg.max_seq_len``. The KV-cache write index is a
+        traced value, so it cannot be range-checked here; past the bound,
+        ``dynamic_update_slice`` clamps the write start and logits go silently
+        wrong. ``dtc_tpu.generate.generate`` enforces this at its static API
+        surface — callers applying the model directly must do the same.
+        """
         h = self.embed(x, train=train, decode=decode)
         h = self.stage(h, train=train, decode=decode)
         return self.head(h)

@@ -96,6 +96,16 @@ def resolve_mesh_shape(
                             f"no pipe size <= {sizes[PIPE]} satisfies "
                             f"n_layers={n_layers} % (pipe * dcn_pipe={pipe_dcn}) == 0"
                         )
+                if pipe != sizes[PIPE]:
+                    # Unconditional print (no jax.process_index(): this helper
+                    # must stay backend-free so it can run before
+                    # jax.distributed.initialize()): a user-pinned data degree
+                    # changes here, which would otherwise be silent.
+                    print(
+                        f"mesh: auto-pp capped pipe {sizes[PIPE]} -> {pipe} "
+                        f"(n_layers={n_layers}); data "
+                        f"{sizes[DATA]} -> {sizes[DATA] * (sizes[PIPE] // pipe)}"
+                    )
                 sizes[DATA] = sizes[DATA] * (sizes[PIPE] // pipe)
                 sizes[PIPE] = pipe
 
@@ -136,6 +146,12 @@ def build_mesh(
                 shape, dcn_shape, devices=devices, allow_split_physical_axes=True
             )
         except ValueError:
+            if getattr(devices[0], "platform", None) == "tpu":
+                # On real TPU a hybrid-mesh failure is a genuine topology
+                # error; a topology-unaware reshape here could silently place
+                # DCN axes across slice boundaries (severe bandwidth
+                # misplacement). Only non-TPU (virtual CPU) falls through.
+                raise
             # Topology-unaware fallback (virtual CPU devices have no
             # slice_index). Keep the hybrid contract: per axis, the DCN
             # factor is the OUTER dimension, so ICI-contiguous device

@@ -45,11 +45,30 @@ def test_synthetic_has_learnable_structure():
     assert match > 0.3
 
 
-def test_tokenizer_offline_fallback():
-    tok = get_tokenizer(allow_download=False)
+def test_tokenizer_offline_fallback_is_opt_in():
+    tok = get_tokenizer(allow_download=False, allow_byte_fallback=True)
     assert len(tok) == GPT2_PADDED_VOCAB or len(tok) > 50000
     ids = tok.encode("hello world")
     assert isinstance(ids, list) and len(ids) > 0
+
+
+def test_tokenizer_raises_without_fallback_flag(monkeypatch):
+    """A missing real tokenizer must FAIL LOUDLY, not silently downgrade
+    (round-3 VERDICT Weak #2). Only when the HF load actually fails."""
+    import pytest
+
+    transformers = pytest.importorskip("transformers")
+
+    def boom(*a, **k):
+        raise OSError("no cache")
+
+    monkeypatch.setattr(transformers.AutoTokenizer, "from_pretrained", boom)
+    monkeypatch.delenv("DTC_ALLOW_BYTE_FALLBACK", raising=False)
+    with pytest.raises(RuntimeError, match="DTC_ALLOW_BYTE_FALLBACK"):
+        get_tokenizer(allow_download=False)
+    # opt-in path still works and returns the byte tokenizer
+    tok = get_tokenizer(allow_download=False, allow_byte_fallback=True)
+    assert len(tok) == GPT2_PADDED_VOCAB
 
 
 def test_prefetch_iterator_matches_sync():
