@@ -1,0 +1,103 @@
+"""Statistical sanity of the pipeline's dropout (round-3 VERDICT Weak #7).
+
+All cross-strategy parity runs use dropout=0.0 (exact-loss comparison), so a
+frozen or biased PP dropout mask would pass every parity/golden test. These
+tests pin the actual derivation the pipeline executes
+(`dtc_tpu.parallel.pipeline.pp_dropout_rng` feeding the real `Block` dropout
+modules): configured keep rate, determinism per (stage, tick) cell, and
+independence across cells.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+
+from dtc_tpu.models.gpt import Block
+from dtc_tpu.parallel.pipeline import pp_dropout_rng
+
+DROP = 0.5
+
+
+def _block_masks(block, params, x, rng):
+    """Apply the real transformer Block and recover its two dropout masks
+    from captured Dropout-module outputs (zero ⇔ dropped; the inputs are
+    continuous dense outputs, so exact zeros otherwise have measure ~0)."""
+    _, inter = block.apply(
+        {"params": params},
+        x,
+        train=True,
+        rngs={"dropout": rng},
+        capture_intermediates=lambda mdl, name: isinstance(mdl, nn.Dropout),
+        mutable=["intermediates"],
+    )
+    outs = jax.tree.leaves(inter)
+    assert len(outs) == 2, f"expected attn+mlp dropout intermediates, got {len(outs)}"
+    return [np.asarray(o == 0) for o in outs]
+
+
+def test_pp_dropout_rate_and_independence(tiny_model_cfg):
+    cfg = dataclasses.replace(tiny_model_cfg, dropout=DROP)
+    block = Block(cfg)
+    # Random input: a constant input would be zeroed by the pre-LN
+    # LayerNorm and make every dropout input exactly 0.
+    x = jax.random.normal(
+        jax.random.PRNGKey(3), (4, cfg.max_seq_len, cfg.d_model), jnp.float32
+    )
+    init_rng = jax.random.PRNGKey(7)
+    params = block.init({"params": init_rng, "dropout": init_rng}, x, train=True)[
+        "params"
+    ]
+
+    base = jax.random.PRNGKey(0)
+    cells = {(s, t): pp_dropout_rng(base, s, t) for s in range(3) for t in range(3)}
+    masks = {k: _block_masks(block, params, x, rng) for k, rng in cells.items()}
+
+    n = x.size
+    tol = 5 * np.sqrt(DROP * (1 - DROP) / n)  # 5 sigma
+    for cell, (m_attn, m_mlp) in masks.items():
+        for m in (m_attn, m_mlp):
+            rate = m.mean()
+            assert abs(rate - DROP) < tol, f"{cell}: drop rate {rate} vs {DROP}"
+        # the two dropouts inside one block draw different masks
+        agree = (m_attn == m_mlp).mean()
+        assert 0.4 < agree < 0.6, f"{cell}: intra-block masks correlated ({agree})"
+
+    # determinism: same (stage, tick) key reproduces the same masks
+    again = _block_masks(block, params, x, cells[(1, 1)])
+    assert np.array_equal(again[0], masks[(1, 1)][0])
+
+    # independence: masks differ across stages and across ticks; for
+    # independent Bernoulli(0.5) masks the agreement fraction is ~0.5
+    keys = list(masks)
+    for i in range(len(keys)):
+        for j in range(i + 1, len(keys)):
+            agree = (masks[keys[i]][0] == masks[keys[j]][0]).mean()
+            assert 0.4 < agree < 0.6, (
+                f"masks for {keys[i]} vs {keys[j]} not independent (agree={agree})"
+            )
+
+
+def test_pp_train_step_dropout_active_and_seeded(tiny_model_cfg, opt_cfg):
+    """End-to-end: the PP step's dropout is live (loss differs from the
+    deterministic run) and fully seed-determined (same seed ⇒ same losses)."""
+    from dtc_tpu.config.schema import MeshConfig
+    from dtc_tpu.train.trainer import train
+    from tests.conftest import make_train_cfg
+
+    mesh = MeshConfig(pipe=4, data=2, model=1)
+    cfg_drop = dataclasses.replace(tiny_model_cfg, dropout=0.3)
+
+    def run(model_cfg, seed):
+        tcfg = make_train_cfg("pp", steps=2, pp_microbatches=2, mesh=mesh, seed=seed)
+        return train(tcfg, model_cfg, opt_cfg).losses
+
+    a = run(cfg_drop, seed=0)
+    b = run(cfg_drop, seed=0)
+    np.testing.assert_array_equal(a, b)
+    c = run(cfg_drop, seed=1)
+    assert not np.array_equal(a, c), "different seed must change dropout masks"
+    d = run(tiny_model_cfg, seed=0)  # dropout=0.0
+    assert not np.array_equal(a, d), "dropout=0.3 must change the loss"
