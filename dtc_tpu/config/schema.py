@@ -42,7 +42,13 @@ class ModelConfig:
     attention: str = "auto"          # auto | dense | flash | ring
     attention_block_q: int = 512     # flash attention query block
     attention_block_kv: int = 512    # flash attention kv block
-    remat: bool = False              # jax.checkpoint each block (HBM <-> FLOPs)
+    # Rematerialisation policy (HBM <-> FLOPs). bool for back-compat:
+    # False/"none" saves all activations, True/"block" checkpoints each
+    # whole block, "mlp" checkpoints only the MLP (drops the d_ff-wide
+    # fc1/gelu intermediates — the bulk of activation memory — while
+    # saving the attention path's residuals, so the backward scan never
+    # re-runs the flash kernel or the qkv projections).
+    remat: bool | str = False
     vocab_pad_multiple: int = 128    # pad vocab so the TP-sharded axis tiles evenly
 
     def __post_init__(self) -> None:
@@ -52,10 +58,23 @@ class ModelConfig:
             )
         if self.attention not in ("auto", "dense", "flash", "ring"):
             raise ValueError(f"unknown attention impl {self.attention!r}")
+        if self.remat_mode not in ("none", "block", "block_save_flash", "mlp"):
+            raise ValueError(
+                f"unknown remat {self.remat!r}; expected bool, 'none', 'block', "
+                "'block_save_flash' or 'mlp'"
+            )
 
     @property
     def head_dim(self) -> int:
         return self.d_model // self.n_heads
+
+    @property
+    def remat_mode(self) -> str:
+        """``remat`` normalized to one of
+        "none" | "block" | "block_save_flash" | "mlp"."""
+        if isinstance(self.remat, bool):
+            return "block" if self.remat else "none"
+        return self.remat
 
     @property
     def padded_vocab_size(self) -> int:

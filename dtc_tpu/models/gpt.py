@@ -31,6 +31,8 @@ TPU-native differences:
 
 from __future__ import annotations
 
+from typing import Any
+
 import jax
 import jax.numpy as jnp
 from flax import linen as nn
@@ -143,7 +145,15 @@ class Block(nn.Module):
             CausalSelfAttention(cfg, name="attn")(h, train=train, decode=decode)
         )
         h = ln("ln_2")(x).astype(_dtype(cfg.compute_dtype))
-        x = x + nn.Dropout(cfg.dropout, deterministic=not train)(MLP(cfg, name="mlp")(h))
+        mlp_cls = MLP
+        if cfg.remat_mode == "mlp" and train and not decode:
+            # Selective remat: only the MLP's d_ff-wide intermediates are
+            # recomputed in backward; the attention path's flash-kernel
+            # residuals (q/k/v/out/lse) stay saved, so the backward scan
+            # skips the ~0.7 ms/layer attention recompute the "block" mode
+            # pays (measured, PERF.md round 4).
+            mlp_cls = nn.remat(MLP, prevent_cse=False)
+        x = x + nn.Dropout(cfg.dropout, deterministic=not train)(mlp_cls(cfg, name="mlp")(h))
         return nn.with_logical_constraint(x, ("batch", "seq", "embed"))
 
 
@@ -226,8 +236,22 @@ class GPTStage(nn.Module):
         self, h: jax.Array, *, train: bool = True, decode: bool = False
     ) -> jax.Array:
         cls = _ScanBlock
-        if self.cfg.remat and not decode:
-            cls = nn.remat(cls, prevent_cse=False)
+        mode = self.cfg.remat_mode
+        if mode in ("block", "block_save_flash") and not decode:
+            kwargs: dict = {"prevent_cse": False}
+            if mode == "block_save_flash":
+                # Block remat, but the flash kernel's full residual set
+                # (q/k/v/out/lse — tagged with checkpoint_name in the
+                # custom-vjp fwd rule) is saved instead of recomputed: the
+                # backward scan re-runs the cheap LN/MLP ops but neither
+                # the attention kernel nor the qkv projections. ~65 MB/layer
+                # of extra HBM at the flagship shape buys back ~4.3 ms/step
+                # of recompute at b32 (device-busy 83.1 -> 78.8 ms, PERF.md
+                # round 4).
+                kwargs["policy"] = jax.checkpoint_policies.save_only_these_names(
+                    "flash_out", "flash_lse", "flash_q", "flash_k", "flash_v"
+                )
+            cls = nn.remat(cls, **kwargs)
         scanned = nn.scan(
             cls,
             variable_axes={"params": 0, "cache": 0},
@@ -239,27 +263,53 @@ class GPTStage(nn.Module):
         return h
 
 
+class _DenseParams(nn.Module):
+    """Parameter container with nn.Dense's exact tree, names, and init
+    (kernel: lecun_normal, bias: zeros) — so GPTHead can hand the raw
+    kernel/bias to the fused head+CE op while staying checkpoint- and
+    sharding-rule-compatible with the nn.Dense layout it replaced."""
+
+    features: int
+    param_dtype: Any
+
+    @nn.compact
+    def __call__(self, in_features: int):
+        kernel = self.param(
+            "kernel", nn.initializers.lecun_normal(),
+            (in_features, self.features), self.param_dtype,
+        )
+        bias = self.param(
+            "bias", nn.initializers.zeros_init(), (self.features,), self.param_dtype
+        )
+        return kernel, bias
+
+
 class GPTHead(nn.Module):
-    """Final LayerNorm + LM head (pipeline last-stage tail)."""
+    """Final LayerNorm + LM head (pipeline last-stage tail).
+
+    With ``targets`` the head returns the mean next-token CE loss via
+    :func:`dtc_tpu.ops.fused_ce.fused_head_ce` (whose backward folds the
+    bias gradient into the dW matmul — one logits pass fewer than autodiff,
+    PERF.md round 4); without, the padded-and-masked logits as before.
+    Both paths share one logits computation (``head_logits``), so train and
+    eval/generate numerics cannot drift apart.
+    """
 
     cfg: ModelConfig
 
     @nn.compact
-    def __call__(self, h: jax.Array) -> jax.Array:
+    def __call__(self, h: jax.Array, targets: jax.Array | None = None) -> jax.Array:
+        from dtc_tpu.ops.fused_ce import fused_head_ce, head_logits
+
         cfg = self.cfg
         h = nn.LayerNorm(name="ln_f", dtype=jnp.float32, param_dtype=jnp.float32)(h)
-        logits = nn.Dense(
-            cfg.padded_vocab_size,
-            name="lm_head",
-            dtype=_dtype(cfg.compute_dtype),
-            param_dtype=_dtype(cfg.param_dtype),
-        )(h.astype(_dtype(cfg.compute_dtype)))
-        if cfg.padded_vocab_size != cfg.vocab_size:
-            # Mask pad columns: contributes exp(-1e9)=0 to any softmax, so
-            # losses/samples over the padded vocab equal the unpadded ones.
-            col = jax.lax.broadcasted_iota(jnp.int32, (cfg.padded_vocab_size,), 0)
-            logits = jnp.where(col < cfg.vocab_size, logits, -1e9).astype(logits.dtype)
-        return nn.with_logical_constraint(logits, ("batch", "seq", "vocab_out"))
+        kernel, bias = _DenseParams(
+            cfg.padded_vocab_size, _dtype(cfg.param_dtype), name="lm_head"
+        )(cfg.d_model)
+        hc = h.astype(_dtype(cfg.compute_dtype))
+        if targets is not None:
+            return fused_head_ce(hc, kernel, bias, targets, cfg.vocab_size)
+        return head_logits(hc, kernel, bias, cfg.vocab_size)
 
 
 class GPT(nn.Module):
@@ -274,9 +324,16 @@ class GPT(nn.Module):
         self.head = GPTHead(self.cfg)
 
     def __call__(
-        self, x: jax.Array, *, train: bool = True, decode: bool = False
+        self,
+        x: jax.Array,
+        *,
+        train: bool = True,
+        decode: bool = False,
+        targets: jax.Array | None = None,
     ) -> jax.Array:
-        """Forward pass.
+        """Forward pass. Returns logits, or — when ``targets`` is given —
+        the mean next-token CE loss via the fused head+CE op (the train
+        step's path; one logits pass cheaper in backward, PERF.md round 4).
 
         ``decode=True`` CALLER CONTRACT: the cumulative decoded length across
         calls must stay <= ``cfg.max_seq_len``. The KV-cache write index is a
@@ -287,7 +344,7 @@ class GPT(nn.Module):
         """
         h = self.embed(x, train=train, decode=decode)
         h = self.stage(h, train=train, decode=decode)
-        return self.head(h)
+        return self.head(h, targets=targets)
 
 
 def param_count(cfg: ModelConfig) -> int:

@@ -35,6 +35,7 @@ import functools
 
 import jax
 import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
@@ -381,6 +382,17 @@ def _flash(q, k, v, block_q, block_kv):
 
 def _flash_fwd(q, k, v, block_q, block_kv):
     out, lse = _fwd_call(q, k, v, block_q, block_kv)
+    # Names make the kernel residuals policy-saveable under remat: with
+    # jax.checkpoint_policies.save_only_these_names("flash_out", "flash_lse")
+    # (ModelConfig remat="block_save_flash"), the backward pass recomputes
+    # the cheap qkv projections but never re-runs this forward kernel —
+    # out/lse are restored from HBM (~17 MB/layer at the flagship shape vs
+    # ~0.5 ms/layer of kernel recompute; measured in PERF.md round 4).
+    out = checkpoint_name(out, "flash_out")
+    lse = checkpoint_name(lse, "flash_lse")
+    q = checkpoint_name(q, "flash_q")
+    k = checkpoint_name(k, "flash_k")
+    v = checkpoint_name(v, "flash_v")
     return out, (q, k, v, out, lse)
 
 
@@ -683,6 +695,12 @@ def _packed_fwd_call(q, k, v, block_q, block_kv, g, d, scale):
 
 def _packed_flash_fwd(q, k, v, block_q, block_kv, g, d, scale):
     out, lse = _packed_fwd_call(q, k, v, block_q, block_kv, g, d, scale)
+    # Policy-saveable residuals — see _flash_fwd for the rationale.
+    out = checkpoint_name(out, "flash_out")
+    lse = checkpoint_name(lse, "flash_lse")
+    q = checkpoint_name(q, "flash_q")
+    k = checkpoint_name(k, "flash_k")
+    v = checkpoint_name(v, "flash_v")
     return out, (q, k, v, out, lse)
 
 

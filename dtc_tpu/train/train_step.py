@@ -46,16 +46,13 @@ def cross_entropy_loss(logits: jax.Array, targets: jax.Array) -> jax.Array:
     a vocab-*sharded* gather cannot be partitioned by XLA SPMD inside a
     partially-manual (shard_map) region — and the masked reduction shards
     cleanly over a vocab-parallel (TP) logits axis anyway.
+
+    Delegates to the single CE implementation in ``ops/fused_ce.py`` so the
+    eval path and the fused train path cannot drift apart.
     """
-    logits = logits.astype(jnp.float32)
-    maxl = jax.lax.stop_gradient(jnp.max(logits, axis=-1, keepdims=True))
-    shifted = logits - maxl
-    logz = jnp.log(jnp.sum(jnp.exp(shifted), axis=-1))
-    vocab_iota = jax.lax.broadcasted_iota(jnp.int32, logits.shape, logits.ndim - 1)
-    gold = jnp.sum(
-        jnp.where(vocab_iota == targets[..., None], shifted, 0.0), axis=-1
-    )
-    return (logz - gold).mean()
+    from dtc_tpu.ops.fused_ce import _stats_loss
+
+    return _stats_loss(logits, targets)[0]
 
 
 def create_gspmd_train_step(
@@ -78,10 +75,11 @@ def create_gspmd_train_step(
         y = nn.with_logical_constraint(batch.y, ("batch", "seq"))
 
         def loss_fn(params: PyTree) -> jax.Array:
-            logits = state.apply_fn(
-                {"params": params}, x, train=True, rngs={"dropout": rng}
+            # targets route the head through the fused head+CE op: same loss
+            # value bitwise, one logits pass fewer in backward (fused_ce.py).
+            return state.apply_fn(
+                {"params": params}, x, train=True, rngs={"dropout": rng}, targets=y
             )
-            return cross_entropy_loss(logits, y)
 
         loss, grads = jax.value_and_grad(loss_fn)(state.params)
         state = state.apply_gradients(grads=grads)

@@ -83,3 +83,74 @@ def test_dropout_needs_rng_and_changes_output(tiny_model_cfg):
     a = model.apply({"params": params}, x, train=True, rngs={"dropout": jax.random.PRNGKey(1)})
     b = model.apply({"params": params}, x, train=True, rngs={"dropout": jax.random.PRNGKey(2)})
     assert not np.allclose(np.asarray(a), np.asarray(b))
+
+
+def test_fused_head_ce_matches_unfused_loss_and_grads(tiny_model_cfg):
+    """The fused head+CE op (train path) must equal logits + cross-entropy
+    (eval path): loss bitwise, grads to ulp-level — its backward only
+    reorders the bias-grad reduction into the dW matmul (ops/fused_ce.py)."""
+    from dtc_tpu.train.train_step import cross_entropy_loss
+
+    model, params = _init(tiny_model_cfg)
+    rng = np.random.default_rng(0)
+    x = jnp.array(rng.integers(0, tiny_model_cfg.vocab_size, size=(2, 16)), dtype=jnp.int32)
+    y = jnp.array(rng.integers(0, tiny_model_cfg.vocab_size, size=(2, 16)), dtype=jnp.int32)
+
+    def fused(p):
+        return model.apply({"params": p}, x, train=False, targets=y)
+
+    def unfused(p):
+        return cross_entropy_loss(model.apply({"params": p}, x, train=False), y)
+
+    lf, gf = jax.value_and_grad(fused)(params)
+    lu, gu = jax.value_and_grad(unfused)(params)
+    assert float(lf) == float(lu), "fused loss value must be bitwise identical"
+    flat_u = dict(jax.tree_util.tree_flatten_with_path(gu)[0])
+    for path, a in jax.tree_util.tree_flatten_with_path(gf)[0]:
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(flat_u[path]), rtol=1e-5, atol=1e-6,
+            err_msg=f"grad mismatch at {path}",
+        )
+
+
+def test_remat_modes_do_not_change_loss(tiny_model_cfg):
+    """Remat is a schedule choice, not a numerics choice: every mode must
+    produce the same loss and grads on the same inputs."""
+    from dataclasses import replace
+
+    rng = np.random.default_rng(1)
+    x = jnp.array(rng.integers(0, tiny_model_cfg.vocab_size, size=(2, 16)), dtype=jnp.int32)
+    y = jnp.array(rng.integers(0, tiny_model_cfg.vocab_size, size=(2, 16)), dtype=jnp.int32)
+    ref_loss, ref_grads = None, None
+    for mode in ("none", "block", "block_save_flash", "mlp"):
+        cfg = replace(tiny_model_cfg, remat=mode)
+        model, params = _init(cfg)
+
+        def loss_fn(p, model=model):
+            return model.apply({"params": p}, x, train=False, targets=y)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        if ref_loss is None:
+            ref_loss, ref_grads = loss, grads
+        else:
+            np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-6)
+            jax.tree.map(
+                lambda a, b: np.testing.assert_allclose(
+                    np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6
+                ),
+                grads, ref_grads,
+            )
+
+
+def test_remat_config_validation():
+    import pytest
+
+    from dataclasses import replace
+    cfg = ModelConfig(
+        vocab_size=97, d_model=64, n_layers=2, n_heads=4, d_ff=128, max_seq_len=32
+    )
+    assert replace(cfg, remat=True).remat_mode == "block"
+    assert replace(cfg, remat=False).remat_mode == "none"
+    assert replace(cfg, remat="block_save_flash").remat_mode == "block_save_flash"
+    with pytest.raises(ValueError):
+        replace(cfg, remat="bogus")
