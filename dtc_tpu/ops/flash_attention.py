@@ -451,7 +451,8 @@ def _packed_tile_bwd(qt, kt, vt, dot_, ot, lse, mask, sl, scale, delta=None):
         qs, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
     )
     p = jnp.exp(s - lse)
-    p = jnp.where(mask, p, 0.0)
+    if mask is not None:  # None = unmasked block (zigzag ring cross-chunks)
+        p = jnp.where(mask, p, 0.0)
     if delta is None:
         delta = jnp.sum(
             do.astype(jnp.float32) * ot[:, sl].astype(jnp.float32),
@@ -818,3 +819,108 @@ def flash_causal_attention(
     tk = lambda x: x.transpose(0, 2, 1, 3)
     out = _flash(tk(q), tk(k), tk(v), block_q, block_kv)
     return out.transpose(0, 2, 1, 3)
+
+
+# ---------------------------------------------------------------------------
+# Ring-block kernels: single-tile attention BLOCKS for the zigzag ring
+# (ops/ring_attention.py). Same packed (B, Tc, H*D) layout and per-group
+# head slicing as the kernels above, but (a) the causal mask is optional —
+# zigzag cross-chunk blocks are strictly past and need none — and (b) the
+# softmax statistics cross the kernel boundary explicitly: forward RETURNS
+# lse so the ring can merge blocks online in jnp; backward TAKES the
+# globally-merged lse (and global out for delta), the standard ring-flash
+# backward contract.
+# ---------------------------------------------------------------------------
+
+
+def _block_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, tc, g, d, scale, causal):
+    qt, kt, vt = q_ref[0], k_ref[0], v_ref[0]
+    mask = _mask(0, 0, tc, tc) if causal else None
+    for gg in range(g):
+        sl = slice(gg * d, (gg + 1) * d)
+        s = jax.lax.dot_general(
+            qt[:, sl] * scale, kt[:, sl], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        if causal:
+            s = jnp.where(mask, s, NEG_INF)
+        m = jnp.max(s, axis=-1, keepdims=True)
+        p = jnp.exp(s - m)
+        l = jnp.sum(p, axis=-1, keepdims=True)
+        acc = jax.lax.dot_general(
+            p.astype(vt.dtype), vt[:, sl], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        o_ref[0, :, sl] = (acc / l).astype(o_ref.dtype)
+        lse_ref[0, 0, :, gg : gg + 1] = m + jnp.log(l)
+
+
+def _block_bwd_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
+                      dq_ref, dk_ref, dv_ref, *, tc, g, d, scale, causal):
+    mask = _mask(0, 0, tc, tc) if causal else None
+    qt, kt, vt = q_ref[0], k_ref[0], v_ref[0]
+    dot_, ot = do_ref[0], o_ref[0]
+    for gg in range(g):
+        sl = slice(gg * d, (gg + 1) * d)
+        lse = lse_ref[0, 0, :, gg : gg + 1]
+        dq_c, dk_c, dv_c = _packed_tile_bwd(
+            qt, kt, vt, dot_, ot, lse, mask, sl, scale
+        )
+        dq_ref[0, :, sl] = dq_c.astype(dq_ref.dtype)
+        dk_ref[0, :, sl] = dk_c.astype(dk_ref.dtype)
+        dv_ref[0, :, sl] = dv_c.astype(dv_ref.dtype)
+
+
+def _block_specs(tc, g):
+    dspec = pl.BlockSpec((1, tc, _LANES), lambda bi, gi: (bi, 0, gi))
+    lsespec = pl.BlockSpec((1, 1, tc, g), lambda bi, gi: (bi, gi, 0, 0))
+    return dspec, lsespec
+
+
+def block_supported(tc: int, h: int, d: int) -> bool:
+    """Can the packed ring-block kernels handle a (B, tc, h*d) chunk?"""
+    return (
+        _packed_group(d, h) is not None and tc % 8 == 0 and tc <= _PACKED_MAX_T
+    )
+
+
+def _block_call(q, k, v, scale, causal, g, d, do=None, o=None, lse=None):
+    """pallas_call wrapper for the ring-block kernels. Forward when
+    ``do is None`` -> (out, lse); backward otherwise -> (dq, dk, dv) fp32."""
+    b, tc, hd = q.shape
+    hg = hd // _LANES
+    dspec, lsespec = _block_specs(tc, g)
+    if do is None:
+        return pl.pallas_call(
+            functools.partial(
+                _block_fwd_kernel, tc=tc, g=g, d=d, scale=scale, causal=causal
+            ),
+            grid=(b, hg),
+            in_specs=[dspec, dspec, dspec],
+            out_specs=[dspec, lsespec],
+            out_shape=[
+                jax.ShapeDtypeStruct((b, tc, hd), q.dtype),
+                jax.ShapeDtypeStruct((b, hg, tc, g), jnp.float32),
+            ],
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=("parallel", "parallel"),
+            ),
+            interpret=_interpret(),
+        )(q, k, v)
+    return pl.pallas_call(
+        functools.partial(
+            _block_bwd_kernel, tc=tc, g=g, d=d, scale=scale, causal=causal
+        ),
+        grid=(b, hg),
+        in_specs=[dspec, dspec, dspec, dspec, dspec, lsespec],
+        out_specs=[dspec, dspec, dspec],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, tc, hd), jnp.float32),
+            jax.ShapeDtypeStruct((b, tc, hd), jnp.float32),
+            jax.ShapeDtypeStruct((b, tc, hd), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel"),
+        ),
+        interpret=_interpret(),
+    )(q, k, v, do, o, lse)

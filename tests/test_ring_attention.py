@@ -100,3 +100,58 @@ def test_ring_under_pipeline_raises_clearly(tiny_model_cfg, opt_cfg, train_cfg_f
     )
     with pytest.raises(ValueError, match="pipeline"):
         train(cfg, ring_model, opt_cfg)
+
+
+def test_zigzag_flops_drop_vs_uniform():
+    """Round-3 VERDICT weak #3 acceptance: the compiled zigzag step must
+    cost ~2x fewer FLOPs than the uniform ring (which computes every block
+    and masks the future half away). Expected ratio 4R/(2R+1) — 32/17 ~ 1.88
+    at R=8; assert comfortably above the no-op level."""
+    mesh = mesh_from_config("3d", MeshConfig(pipe=1, data=1, model=8))
+    q, k, v = _qkv(jax.random.PRNGKey(4), 1, 2048, 2, 16)
+
+    def flops(schedule):
+        with mesh:
+            fn = jax.jit(
+                lambda q, k, v: ring_causal_attention(q, k, v, schedule=schedule)
+            )
+            cost = fn.lower(q, k, v).compile().cost_analysis()
+        return float(cost["flops"])
+
+    ratio = flops("uniform") / flops("zigzag")
+    assert ratio > 1.6, f"zigzag should cut ring FLOPs ~2x, got {ratio:.2f}x"
+
+
+def test_zigzag_and_uniform_schedules_agree():
+    mesh = mesh_from_config("3d", MeshConfig(pipe=1, data=2, model=4))
+    q, k, v = _qkv(jax.random.PRNGKey(5), 2, 64, 2, 16)
+    with mesh:
+        zz = jax.jit(lambda q, k, v: ring_causal_attention(q, k, v, schedule="zigzag"))(q, k, v)
+        un = jax.jit(lambda q, k, v: ring_causal_attention(q, k, v, schedule="uniform"))(q, k, v)
+    np.testing.assert_allclose(np.asarray(zz), np.asarray(un), atol=2e-5)
+
+
+def test_zigzag_kernel_blocks_match_dense(monkeypatch):
+    """The Pallas-backed zigzag path (per-block packed kernels + whole-ring
+    custom VJP, forced via DTC_RING_FLASH=1 so it runs in interpret mode on
+    the CPU mesh) must match dense causal attention forward AND gradients —
+    round-3 VERDICT weak #3's 'route the per-block compute through the
+    packed flash kernel'."""
+    monkeypatch.setenv("DTC_RING_FLASH", "1")
+    mesh = mesh_from_config("3d", MeshConfig(pipe=1, data=2, model=4))
+    # head_dim 32 -> 4 heads/group; tc = 128/(2*4) = 16 rows per chunk.
+    q, k, v = _qkv(jax.random.PRNGKey(6), 2, 128, 4, 32)
+
+    ref = dense_causal_attention(q, k, v)
+    g_ref = jax.grad(lambda q, k, v: jnp.sum(dense_causal_attention(q, k, v) ** 2),
+                     argnums=(0, 1, 2))(q, k, v)
+    with mesh:
+        got = jax.jit(lambda q, k, v: ring_causal_attention(q, k, v))(q, k, v)
+        g_got = jax.jit(jax.grad(
+            lambda q, k, v: jnp.sum(ring_causal_attention(q, k, v) ** 2),
+            argnums=(0, 1, 2),
+        ))(q, k, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=2e-5)
+    for name, a, b in zip("qkv", g_ref, g_got):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a), atol=2e-3,
+                                   err_msg=f"d{name}")
