@@ -139,6 +139,13 @@ class TrainConfig:
     output_dir: str
     pp_microbatches: int = 1
     # --- TPU-native extensions ---
+    # Pipeline schedule: "gpipe" (fill-drain via autodiff through the clock
+    # scan — the reference's semantics, loss-parity default) or "1f1b"
+    # (hand-scheduled one-forward-one-backward: O(stages) in-flight
+    # activations instead of O(microbatches); same loss to float tolerance
+    # at dropout=0 — with dropout the schedules draw different, equally
+    # valid masks, see create_1f1b_train_step).
+    pp_schedule: str = "gpipe"
     mesh: MeshConfig = field(default_factory=MeshConfig)
     dataset: str = "fineweb"     # fineweb | synthetic
     warmup_steps: int = 5        # untimed warmup steps (reference uses 5)
@@ -167,6 +174,8 @@ class TrainConfig:
             raise ValueError(f"unknown dataset {self.dataset!r}")
         if self.pp_microbatches < 1:
             raise ValueError("pp_microbatches must be >= 1")
+        if self.pp_schedule not in ("gpipe", "1f1b"):
+            raise ValueError(f"unknown pp_schedule {self.pp_schedule!r}")
         if self.prng_impl not in ("threefry2x32", "rbg", "unsafe_rbg"):
             raise ValueError(f"unknown prng_impl {self.prng_impl!r}")
         if self.batch % self.pp_microbatches != 0:

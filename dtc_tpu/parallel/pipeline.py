@@ -311,3 +311,325 @@ def create_pp_train_step(
         return state, loss
 
     return train_step
+
+
+# --------------------------------------------------------------------------
+# 1F1B schedule
+# --------------------------------------------------------------------------
+
+def simulate_1f1b(m: int, s_count: int):
+    """Static 1F1B schedule tables.
+
+    Greedy lock-step simulation (each tick has one F slot then one B slot):
+    stage s forwards its next microbatch when the activation arrived from
+    s-1 on an earlier tick and its in-flight count is below the Megatron
+    cap S-s; it backwards its next microbatch when the cotangent arrived
+    from s+1 (the last stage may backward in the same tick it forwards,
+    the head runs in-tick). Returns (JF, JB): per-tick lists of per-stage
+    microbatch indices, -1 = idle slot. The tables are Python constants —
+    the SPMD tick program looks its row up by stage_id at run time.
+    """
+    f_done = [[-1] * m for _ in range(s_count)]
+    b_done = [[-1] * m for _ in range(s_count)]
+    next_f = [0] * s_count
+    next_b = [0] * s_count
+    jf_rows, jb_rows = [], []
+    tick = 0
+    limit = 4 * (m + s_count) + 8
+    while any(nb < m for nb in next_b) and tick < limit:
+        jf_row = []
+        for s in range(s_count):
+            j = next_f[s]
+            ok = j < m
+            if ok and s > 0:
+                ok = 0 <= f_done[s - 1][j] < tick
+            if ok:
+                ok = (j - next_b[s]) < (s_count - s)  # 1F1B in-flight cap
+            if ok:
+                f_done[s][j] = tick
+                next_f[s] += 1
+                jf_row.append(j)
+            else:
+                jf_row.append(-1)
+        jb_row = []
+        for s in range(s_count):
+            j = next_b[s]
+            ok = j < m
+            if ok:
+                if s == s_count - 1:
+                    ok = 0 <= f_done[s][j] <= tick  # same-tick F->head->B
+                else:
+                    ok = 0 <= b_done[s + 1][j] < tick
+            if ok:
+                b_done[s][j] = tick
+                next_b[s] += 1
+                jb_row.append(j)
+            else:
+                jb_row.append(-1)
+        jf_rows.append(jf_row)
+        jb_rows.append(jb_row)
+        tick += 1
+    if any(nb < m for nb in next_b):
+        raise RuntimeError(f"1f1b schedule did not converge for m={m} S={s_count}")
+    # The runtime stores in-transit activations/cotangents in S-slot ring
+    # buffers keyed by microbatch % S (a single ppermute register is NOT
+    # enough: the schedule legally leaves multi-tick gaps between production
+    # and consumption, during which an idle neighbor would clobber the wire
+    # with zeros). Verify at build time that no slot is ever overwritten
+    # while its previous occupant is still live.
+    for s in range(1, s_count):
+        for j in range(m - s_count):
+            # Activation j+S arrives at stage s only after stage s consumed
+            # (backwarded) activation j, freeing slot j % S.
+            assert f_done[s - 1][j + s_count] + 1 > b_done[s][j], (
+                f"activation slot collision at stage {s}, mb {j}"
+            )
+    for s in range(s_count - 1):
+        for j in range(m - s_count):
+            assert b_done[s + 1][j + s_count] + 1 > b_done[s][j], (
+                f"cotangent slot collision at stage {s}, mb {j}"
+            )
+    return jf_rows, jb_rows
+
+
+def create_1f1b_train_step(
+    model,
+    mesh: Mesh,
+    *,
+    num_microbatches: int,
+    rules: Sequence[tuple[str, str | None]] = DEFAULT_RULES,
+    chunk_vocab: bool | None = None,
+):
+    """1F1B-scheduled pipeline train step (``pp_schedule: 1f1b``).
+
+    Same stacked-param layout, ring topology, seq-chunked embed/head, and
+    loss semantics as the GPipe step — the losses agree to float tolerance
+    (asserted in tests) — but the backward is HAND-SCHEDULED instead of
+    autodiff-through-the-scan: each tick runs one forward slot and one
+    backward slot (``jax.vjp`` with the stage forward recomputed from an
+    S-slot activation buffer), per the static tables of
+    :func:`simulate_1f1b`. The reference has no 1F1B (GPipe fill-drain
+    only, `/root/reference/train/create_train_step.py:55-195`); SURVEY §2.2
+    marks it "optionally add later".
+
+    Why: in-flight activations drop from O(M) stacked scan ticks (GPipe
+    autodiff keeps every tick's output alive into the backward scan) to
+    O(S) circular buffers — the compiled temp-memory ratio is asserted in
+    tests. The fill-drain bubble *ratio* is unchanged (non-interleaved
+    1F1B matches GPipe), but large M — the thing that actually shrinks the
+    bubble (S-1)/(M+S-1) — stops costing memory proportional to M.
+
+    Caveats (documented limits, not bugs):
+
+    - Loss parity with GPipe holds at dropout=0 (the cross-schedule
+      comparison regime, like DP-vs-PP). With dropout>0 both schedules are
+      *valid* but draw different masks: GPipe keys dropout on
+      (stage, clock tick), 1F1B on (stage, microbatch) — tick numbering is
+      schedule-specific, so mask-identical runs are impossible by design.
+    - The tick loop is unrolled in Python, so traced-program size grows
+      O(M) (fine through M ~ 32; the tables themselves are O(1) to build).
+      A lax.scan over the table rows would cap program size at the cost of
+      running every tick's embed/head/backward pieces masked — the GPipe
+      path already occupies that point in the design space.
+    """
+    cfg = model.cfg
+    num_stages = mesh.shape["pipe"]
+    if cfg.n_layers % num_stages != 0:
+        raise ValueError(
+            f"n_layers={cfg.n_layers} not divisible by pipe={num_stages} stages"
+        )
+    layers_per_stage = cfg.n_layers // num_stages
+    m = num_microbatches
+    if chunk_vocab is None:
+        chunk_vocab = num_stages > 1 and cfg.max_seq_len % num_stages == 0
+
+    embed_mod = GPTEmbed(cfg, lookup="onehot")
+    stage_mod = GPTStage(cfg, layers_per_stage)
+    head_mod = GPTHead(cfg)
+
+    jf_rows, jb_rows = simulate_1f1b(m, num_stages)
+    n_ticks = len(jf_rows)
+
+    fwd_perm = [(i, i + 1) for i in range(num_stages - 1)]
+    bwd_perm = [(i + 1, i) for i in range(num_stages - 1)]
+
+    def fwd_bwd(params: PyTree, x_mb: jax.Array, y_mb: jax.Array, rng: jax.Array):
+        stage_id = lax.axis_index("pipe")
+        is_first = stage_id == 0
+        is_last = stage_id == num_stages - 1
+        stage_params = jax.tree.map(lambda a: jnp.squeeze(a, 0), params["stage"])
+
+        mb, t = x_mb.shape[1], x_mb.shape[2]
+        cdtype = _dtype(cfg.compute_dtype)
+        h_zeros = jnp.zeros((mb, t, cfg.d_model), dtype=cdtype)
+        tc = t // num_stages if chunk_vocab else t
+
+        def embed_fn(embed_p, j: int):
+            """Seq-chunked embed of STATIC microbatch j (cooperative)."""
+            x_j = x_mb[j]
+            erng = {"dropout": pp_dropout_rng(rng, stage_id, 10_000 + j)}
+            if not chunk_vocab:
+                return embed_mod.apply({"params": embed_p}, x_j, train=True, rngs=erng)
+            x_chunk = lax.dynamic_slice_in_dim(x_j, stage_id * tc, tc, axis=1)
+            h_chunk = embed_mod.apply(
+                {"params": embed_p}, x_chunk, train=True,
+                pos_offset=stage_id * tc, rngs=erng,
+            )
+            return lax.all_gather(h_chunk, "pipe", axis=1, tiled=True)
+
+        def head_fn(head_p, h_out, j: int):
+            """This stage's share of microbatch j's mean-CE/m (cooperative)."""
+            from dtc_tpu.train.train_step import cross_entropy_loss
+
+            y_j = y_mb[j]
+            if not chunk_vocab:
+                logits = head_mod.apply({"params": head_p}, h_out)
+                return jnp.where(is_last, cross_entropy_loss(logits, y_j), 0.0) / m
+            contrib = jnp.where(is_last, h_out, h_zeros)
+            pieces = contrib.reshape(mb, num_stages, tc, cfg.d_model)
+            pieces = pieces.transpose(1, 0, 2, 3)
+            routed = lax.all_to_all(pieces, "pipe", split_axis=0, concat_axis=0)
+            my_chunk = routed.sum(axis=0)
+            y_chunk = lax.dynamic_slice_in_dim(y_j, stage_id * tc, tc, axis=1)
+            logits = head_mod.apply({"params": head_p}, my_chunk)
+            return cross_entropy_loss(logits, y_chunk) / (num_stages * m)
+
+        def stage_fn(stage_p, h_in, jf):
+            """Stage chunk for (traced) microbatch jf; rng unique per
+            (stage, microbatch) — 1F1B tick numbering differs from GPipe's,
+            so keys derive from the microbatch index, not the tick."""
+            return stage_mod.apply(
+                {"params": stage_p}, h_in, train=True,
+                rngs={"dropout": pp_dropout_rng(rng, stage_id, jf + 1)},
+            )
+
+        # Running state. Activations and cotangents live in S-slot ring
+        # buffers keyed by microbatch % S: the schedule allows multi-tick
+        # gaps between a neighbor producing a tensor and this stage
+        # consuming it, so the bare ppermute wire (overwritten every tick,
+        # with zeros when the neighbor idles) cannot carry them alone.
+        # simulate_1f1b asserts slot lifetimes never collide.
+        buf = jnp.zeros((num_stages, mb, t, cfg.d_model), dtype=cdtype)
+        g_buf = jnp.zeros((num_stages, mb, t, cfg.d_model), dtype=cdtype)
+        h_ring = h_zeros          # fwd wire: stage-1's output, last tick
+        g_ring = h_zeros          # bwd wire: stage+1's cotangent, last tick
+        dh_head = h_zeros         # head cotangent for the last stage, this tick
+        loss = jnp.zeros((), jnp.float32)
+        g_embed = jax.tree.map(lambda a: jnp.zeros_like(a, jnp.float32), params["embed"])
+        g_stage = jax.tree.map(jnp.zeros_like, stage_params)
+        g_head = jax.tree.map(lambda a: jnp.zeros_like(a, jnp.float32), params["head"])
+
+        def buf_put(buffer, value, slot, valid):
+            slot = jnp.where(valid, slot, 0)
+            keep = lax.dynamic_index_in_dim(buffer, slot, keepdims=False)
+            return lax.dynamic_update_index_in_dim(
+                buffer, jnp.where(valid, value, keep), slot, axis=0
+            )
+
+        for tick in range(n_ticks):
+            jf_row, jb_row = jf_rows[tick], jb_rows[tick]
+            jf = jnp.take(jnp.asarray(jf_row, jnp.int32), stage_id)
+            valid_f = jf >= 0
+
+            # ---- deliver last tick's wires into the ring buffers --------
+            if tick > 0:
+                # What did my fwd-neighbor (stage-1) / bwd-neighbor
+                # (stage+1) send last tick? Static table rows, shifted.
+                sent_f = [-1] + jf_rows[tick - 1][: num_stages - 1]
+                sent_b = jb_rows[tick - 1][1:] + [-1]
+                sf = jnp.take(jnp.asarray(sent_f, jnp.int32), stage_id)
+                buf = buf_put(buf, h_ring, sf % num_stages, sf >= 0)
+                if any(j >= 0 for j in sent_b):
+                    sb = jnp.take(jnp.asarray(sent_b, jnp.int32), stage_id)
+                    g_buf = buf_put(g_buf, g_ring, sb % num_stages, sb >= 0)
+
+            # ---- F slot -------------------------------------------------
+            if jf_row[0] >= 0:
+                h0 = embed_fn(params["embed"], jf_row[0])
+            else:
+                h0 = h_zeros
+            slot = jnp.where(valid_f, jf % num_stages, 0)
+            h_arrived = lax.dynamic_index_in_dim(buf, slot, keepdims=False)
+            h_in = jnp.where(is_first, h0, h_arrived)
+            h_out = stage_fn(stage_params, h_in, jnp.maximum(jf, 0))
+            h_out = jnp.where(valid_f, h_out, h_zeros)
+            # Stash h_in for the backward recompute (same slot; for
+            # stages > 0 this re-writes the delivered value, for stage 0 it
+            # stores the embed output).
+            buf = buf_put(buf, h_in, slot, valid_f)
+
+            # ---- head piece (cooperative, static mb) --------------------
+            jh = jf_row[num_stages - 1]
+            if jh >= 0:
+                (lj, head_vjp) = jax.vjp(lambda hp, h: head_fn(hp, h, jh),
+                                         params["head"], h_out)
+                loss = loss + lj
+                dhp, dh_head = head_vjp(jnp.ones((), jnp.float32))
+                g_head = jax.tree.map(jnp.add, g_head, dhp)
+            else:
+                dh_head = h_zeros
+
+            # ---- B slot -------------------------------------------------
+            jb_any = any(j >= 0 for j in jb_row)
+            if jb_any:
+                jb = jnp.take(jnp.asarray(jb_row, jnp.int32), stage_id)
+                valid_b = jb >= 0
+                slot_b = jnp.where(valid_b, jb % num_stages, 0)
+                g_arrived = lax.dynamic_index_in_dim(g_buf, slot_b, keepdims=False)
+                g_in = jnp.where(is_last, dh_head, g_arrived)
+                g_in = jnp.where(valid_b, g_in, h_zeros)
+                h_saved = lax.dynamic_index_in_dim(buf, slot_b, keepdims=False)
+                _, stage_vjp = jax.vjp(
+                    lambda sp, h: stage_fn(sp, h, jnp.maximum(jb, 0)),
+                    stage_params, h_saved,
+                )
+                dsp, dh_prev = stage_vjp(g_in.astype(cdtype))
+                g_stage = jax.tree.map(jnp.add, g_stage, dsp)
+                # Cotangent leaving stage 0 is the embed output's: feed the
+                # cooperative embed VJP (static mb from the table).
+                if jb_row[0] >= 0:
+                    _, embed_vjp = jax.vjp(
+                        lambda ep: embed_fn(ep, jb_row[0]), params["embed"]
+                    )
+                    (dep,) = embed_vjp(
+                        jnp.where(is_first, dh_prev, h_zeros).astype(cdtype)
+                    )
+                    g_embed = jax.tree.map(jnp.add, g_embed, dep)
+            else:
+                dh_prev = h_zeros
+
+            # ---- ring shifts -------------------------------------------
+            if num_stages > 1:
+                h_ring = lax.ppermute(h_out, "pipe", fwd_perm)
+                g_ring = lax.ppermute(
+                    dh_prev if jb_any else h_zeros, "pipe", bwd_perm
+                )
+
+        loss = lax.psum(loss, "pipe")
+        g_embed = lax.psum(g_embed, "pipe")
+        g_head = lax.psum(g_head, "pipe")
+        g_stage = jax.tree.map(lambda a: a[None], g_stage)
+        return loss, {"embed": g_embed, "stage": g_stage, "head": g_head}
+
+    param_pipe_specs = {"embed": P(), "stage": P("pipe"), "head": P()}
+    sharded_fwd_bwd = jax.shard_map(
+        fwd_bwd,
+        mesh=mesh,
+        in_specs=(param_pipe_specs, P(), P(), P()),
+        out_specs=(P(), param_pipe_specs),
+        axis_names={"pipe"},
+        check_vma=False,
+    )
+
+    @partial(jax.jit, donate_argnums=(0,))
+    def train_step(state, batch, rng: jax.Array):
+        b, t = batch.x.shape
+        x_mb = batch.x.reshape(m, b // m, t)
+        y_mb = batch.y.reshape(m, b // m, t)
+        x_mb = nn.with_logical_constraint(x_mb, ("microbatch", "batch", "seq"))
+        y_mb = nn.with_logical_constraint(y_mb, ("microbatch", "batch", "seq"))
+        loss, grads = sharded_fwd_bwd(state.params, x_mb, y_mb, rng)
+        state = state.apply_gradients(grads=grads)
+        return state, loss
+
+    return train_step

@@ -225,7 +225,8 @@ def train(
                     print(f"[dtc_tpu] resumed from checkpoint step {start_step}")
 
         train_step = create_train_step(
-            mesh, model=model, num_microbatches=train_cfg.pp_microbatches, rules=rules
+            mesh, model=model, num_microbatches=train_cfg.pp_microbatches,
+            rules=rules, pp_schedule=train_cfg.pp_schedule,
         )
 
         # Resume parity: the interrupted run consumed warmup_steps +

@@ -1,0 +1,124 @@
+"""1F1B pipeline schedule (round-3 VERDICT next #3).
+
+The 1F1B step must (a) produce the same losses as the GPipe step it
+coexists with (same stacked params, ring, seq-chunked vocab work), and
+(b) actually deliver the thing it exists for: in-flight activation memory
+O(stages) instead of O(microbatches) — asserted on the compiled programs'
+temp memory at M >> S. Schedule-table invariants are pinned separately so
+a simulator regression cannot silently reorder dependencies.
+"""
+
+import dataclasses
+
+import jax
+import numpy as np
+import pytest
+
+from dtc_tpu.config.schema import MeshConfig
+from dtc_tpu.parallel.pipeline import simulate_1f1b
+from dtc_tpu.train.trainer import train
+
+
+def test_simulate_1f1b_schedule_invariants():
+    for m, s in [(2, 2), (4, 2), (8, 4), (3, 4), (1, 2), (5, 3)]:
+        jf, jb = simulate_1f1b(m, s)
+        f_tick = {}
+        b_tick = {}
+        for tick, (frow, brow) in enumerate(zip(jf, jb)):
+            for stage in range(s):
+                if frow[stage] >= 0:
+                    f_tick[(frow[stage], stage)] = tick
+                if brow[stage] >= 0:
+                    b_tick[(brow[stage], stage)] = tick
+        # Every microbatch forwards and backwards exactly once per stage.
+        assert set(f_tick) == {(j, st) for j in range(m) for st in range(s)}
+        assert set(b_tick) == set(f_tick)
+        for j in range(m):
+            for st in range(s):
+                # Dataflow: fwd needs the previous stage's output from an
+                # EARLIER tick (ppermute latency); bwd needs the next
+                # stage's cotangent likewise; last stage may bwd in-tick.
+                if st > 0:
+                    assert f_tick[(j, st)] > f_tick[(j, st - 1)]
+                if st < s - 1:
+                    assert b_tick[(j, st)] > b_tick[(j, st + 1)]
+                else:
+                    assert b_tick[(j, st)] >= f_tick[(j, st)]
+        # 1F1B cap: at most S - stage microbatches in flight per stage.
+        for st in range(s):
+            for tick in range(len(jf)):
+                inflight = sum(
+                    1 for j in range(m)
+                    if f_tick[(j, st)] <= tick and b_tick[(j, st)] > tick
+                )
+                assert inflight <= s - st, (st, tick, inflight)
+
+
+@pytest.mark.parametrize("strategy,microbatches,mesh_kw", [
+    ("pp", 2, dict(pipe=4, data=2)),
+    # m > 2 with S > 2: the schedule has multi-tick production->consumption
+    # gaps, exercising the S-slot ring buffers (a single ppermute register
+    # gets clobbered by an idle neighbor's zeros — caught in review).
+    ("pp", 4, dict(pipe=4, data=2)),
+    ("3d", 2, dict(pipe=2, data=2, model=2)),
+])
+def test_1f1b_loss_matches_gpipe(tiny_model_cfg, opt_cfg, train_cfg_factory,
+                                 strategy, microbatches, mesh_kw):
+    gp = train(
+        train_cfg_factory(strategy, steps=3, pp_microbatches=microbatches,
+                          mesh=MeshConfig(**mesh_kw)),
+        tiny_model_cfg, opt_cfg,
+    )
+    ob = train(
+        train_cfg_factory(strategy, steps=3, pp_microbatches=microbatches,
+                          pp_schedule="1f1b", mesh=MeshConfig(**mesh_kw)),
+        tiny_model_cfg, opt_cfg,
+    )
+    np.testing.assert_allclose(ob.losses, gp.losses, rtol=5e-4, atol=5e-4)
+
+
+def test_1f1b_temp_memory_below_gpipe_at_large_m(tiny_model_cfg, opt_cfg):
+    """The point of 1F1B: compiled temp memory must not scale with M.
+    At M=8, S=4 the GPipe step keeps all M+S-1 tick activations alive into
+    the backward scan; 1F1B keeps an S-slot buffer."""
+    import jax.numpy as jnp
+    from flax import linen as nn
+
+    from dtc_tpu.models.gpt import GPT
+    from dtc_tpu.parallel.mesh import mesh_from_config
+    from dtc_tpu.parallel.pipeline import (
+        create_1f1b_train_step, create_pp_train_step, pp_stack_params,
+    )
+    from dtc_tpu.parallel.sharding import DEFAULT_RULES
+    from dtc_tpu.train.train_step import Batch
+    from tests.conftest import make_train_cfg
+
+    # Big enough that the O(M) vs O(S) activation term dominates the
+    # constant temps (embed one-hot buffers, head logits): at the conftest
+    # tiny shape both programs' temp memory is all fixed overhead.
+    cfg = dataclasses.replace(
+        tiny_model_cfg, n_layers=4, d_model=128, n_heads=4, d_ff=256,
+        max_seq_len=64,
+    )
+    mesh = mesh_from_config("pp", MeshConfig(pipe=4, data=2))
+    model = GPT(cfg)
+    m = 16
+    batch = 64
+    t = cfg.max_seq_len
+
+    from dtc_tpu.train.trainer import init_state
+    train_cfg = make_train_cfg("pp", steps=1, batch=batch, pp_microbatches=m,
+                               mesh=MeshConfig(pipe=4, data=2))
+    with mesh, nn.logical_axis_rules(DEFAULT_RULES):
+        state = init_state(model, cfg, train_cfg, opt_cfg, mesh, DEFAULT_RULES)
+        x = jnp.zeros((batch, t), jnp.int32)
+        b = Batch(x=x, y=x)
+        rng = jax.random.PRNGKey(0)
+
+        def temp_bytes(step_fn):
+            comp = step_fn.lower(state, b, rng).compile()
+            return comp.memory_analysis().temp_size_in_bytes
+
+        gp = temp_bytes(create_pp_train_step(model, mesh, num_microbatches=m))
+        ob = temp_bytes(create_1f1b_train_step(model, mesh, num_microbatches=m))
+    assert ob < gp, f"1f1b temp {ob} should undercut gpipe temp {gp}"
