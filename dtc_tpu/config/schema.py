@@ -159,6 +159,10 @@ class TrainConfig:
     checkpoint_dir: str = ""     # default: <output_dir>/checkpoints
     eval_every: int = 0          # periodic held-out eval loss; 0 = disabled
     eval_batches: int = 8        # batches per eval pass
+    # Streaming (fineweb) eval holdout: every Nth packed batch from the
+    # stream head is diverted into the eval set (training never sees it) —
+    # see dtc_tpu/data/holdout.py. Ignored for synthetic (disjoint seeds).
+    eval_holdout_every: int = 10
     resume: bool = True          # resume from latest checkpoint if present
     profile_start: int = 0       # capture jax.profiler trace [start, stop)
     profile_stop: int = 0
@@ -176,6 +180,8 @@ class TrainConfig:
             raise ValueError("pp_microbatches must be >= 1")
         if self.pp_schedule not in ("gpipe", "1f1b"):
             raise ValueError(f"unknown pp_schedule {self.pp_schedule!r}")
+        if self.eval_holdout_every < 1:
+            raise ValueError("eval_holdout_every must be >= 1")
         if self.prng_impl not in ("threefry2x32", "rbg", "unsafe_rbg"):
             raise ValueError(f"unknown prng_impl {self.prng_impl!r}")
         if self.batch % self.pp_microbatches != 0:

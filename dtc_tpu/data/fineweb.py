@@ -4,7 +4,7 @@ Same source, split, and packing semantics as
 `/root/reference/data/fineweb_edu.py:15-39` — HuggingFace streaming of
 ``HuggingFaceFW/fineweb-edu`` train split, per-document tokenization,
 boundary-free concatenation — but the packing is delegated to
-:func:`dtc_tpu.data.packing.pack_token_stream` and tokenization can run in a
+:class:`dtc_tpu.data.packing.TokenPacker` and tokenization can run in a
 background thread so the (network + CPU)-bound work overlaps device compute
 instead of sitting on the training critical path (the reference tokenizes
 synchronously inside the step loop, SURVEY.md §3.4).
@@ -12,36 +12,134 @@ synchronously inside the step loop, SURVEY.md §3.4).
 Multi-host: documents are striped round-robin by ``process_index`` /
 ``process_count`` so every pod host tokenizes a DISJOINT slice of the
 stream (the reference is single-process and has no notion of this).
+
+Resume: :class:`FinewebStream` tracks a per-batch position (documents
+consumed + leftover buffer tokens) that the trainer checkpoints alongside
+the Orbax state; a resumed run seeks — ``dataset.skip`` over already-read
+raw documents, buffer restored — instead of re-downloading and
+re-tokenizing everything consumed so far.
 """
 
 from __future__ import annotations
 
+import itertools
+import threading
+from collections import deque
 from typing import Iterable, Iterator
 
 import numpy as np
 
-from dtc_tpu.data.packing import pack_token_stream
+from dtc_tpu.data.packing import TokenPacker
 from dtc_tpu.data.tokenizer import get_tokenizer
 
 
 def stride_documents(
-    documents: Iterable, process_index: int, process_count: int
+    documents: Iterable, process_index: int, process_count: int,
+    start_index: int = 0,
 ) -> Iterator:
-    """Round-robin stripe of a document stream: process p sees items
-    p, p+N, p+2N, … — disjoint across processes, union = full stream."""
-    for i, item in enumerate(documents):
+    """Round-robin stripe of a document stream: process p sees items with
+    ABSOLUTE index ≡ p (mod N) — disjoint across processes, union = full
+    stream. ``start_index`` is the absolute index of the first item of
+    ``documents`` (nonzero when the underlying stream was ``.skip()``-ed),
+    so striping stays aligned across resumes."""
+    for i, item in enumerate(documents, start=start_index):
         if i % process_count == process_index:
             yield item
 
 
 def _document_tokens(
-    tokenizer, process_index: int, process_count: int
+    tokenizer, process_index: int, process_count: int, raw_skip: int = 0
 ) -> Iterator[list[int]]:
     from datasets import load_dataset  # network-bound import kept local
 
     ds = load_dataset("HuggingFaceFW/fineweb-edu", split="train", streaming=True)
-    for item in stride_documents(ds, process_index, process_count):
+    if raw_skip:
+        # Server/shard-aware skip: the resumed run does not re-download or
+        # re-tokenize already-consumed documents.
+        ds = ds.skip(raw_skip)
+    for item in stride_documents(ds, process_index, process_count, raw_skip):
         yield tokenizer.encode(item["text"])
+
+
+class FinewebStream:
+    """Resumable FineWeb batch iterator.
+
+    Yields (batch_size, seq_len) int32 batches. ``position`` (from a prior
+    stream's :meth:`position_after`) seeks the document source and restores
+    the packer buffer, so the resumed stream continues batch-exactly where
+    the checkpointed one stopped. A bounded history of per-yield positions
+    lets the trainer ask for the position as of the batch TRAINING consumed
+    even while the prefetch pipeline has pulled a few batches ahead.
+
+    ``documents`` injects a pre-tokenized RAW document stream (tests /
+    offline); it is striped and skipped exactly like the network path.
+    """
+
+    def __init__(
+        self,
+        batch_size: int,
+        seq_len: int,
+        tokenizer=None,
+        *,
+        process_index: int = 0,
+        process_count: int = 1,
+        documents: Iterator[list[int]] | None = None,
+        position: dict | None = None,
+        history: int = 64,
+    ):
+        pos = position or {"docs_consumed": 0, "buffer": []}
+        skip = int(pos["docs_consumed"])  # STRIPED documents already consumed
+        # The k-th striped document for process p is raw index p + k*N: after
+        # `skip` striped docs the next raw index to read is p + skip*N, so
+        # skipping skip*N raw documents keeps every process phase-aligned.
+        raw_skip = skip * process_count
+        if documents is not None:
+            if hasattr(documents, "__getitem__"):
+                # Sequence: true seek (mirrors the network path's ds.skip) —
+                # already-consumed documents are never touched again, which
+                # the resume tests assert.
+                raw = iter(documents[raw_skip:])
+            else:
+                raw = itertools.islice(documents, raw_skip, None)
+            docs = stride_documents(raw, process_index, process_count, raw_skip)
+        else:
+            docs = _document_tokens(
+                tokenizer or get_tokenizer(), process_index, process_count, raw_skip
+            )
+        self._packer = TokenPacker(
+            docs, batch_size, seq_len, docs_consumed=skip, buffer=pos["buffer"]
+        )
+        #: stream index of the most recently yielded batch (1-based count).
+        self.yielded = 0
+        self._history: deque[tuple[int, dict]] = deque(maxlen=history)
+        # __next__ runs on the prefetch worker thread while position_after
+        # runs on the main thread at checkpoint time — guard the deque.
+        self._lock = threading.Lock()
+
+    def __iter__(self) -> "FinewebStream":
+        return self
+
+    def __next__(self) -> np.ndarray:
+        batch = next(self._packer)
+        with self._lock:
+            self.yielded += 1
+            self._history.append((self.yielded, self._packer.position()))
+        return batch
+
+    def position_after(self, stream_index: int) -> dict:
+        """The resume position as of the ``stream_index``-th yielded batch
+        (1-based). Only a bounded window of recent yields is retained —
+        enough to cover prefetch look-ahead and eval-holdout gaps (the
+        trainer sizes ``history`` past the holdout span)."""
+        with self._lock:
+            entries = list(self._history)
+        for n, p in entries:
+            if n == stream_index:
+                return p
+        raise KeyError(
+            f"position for stream index {stream_index} not in history "
+            f"(have {[n for n, _ in entries]}); increase history="
+        )
 
 
 def fineweb_batch_iterator(
@@ -54,14 +152,9 @@ def fineweb_batch_iterator(
     documents: Iterator[list[int]] | None = None,
 ) -> Iterator[np.ndarray]:
     """Yield (batch_size, seq_len) int32 batches from streamed FineWeb-Edu.
-
-    ``documents`` injects a pre-tokenized document stream (tests / offline);
-    when given it is ALSO striped by process, so the multi-host contract is
-    testable without the network.
-    """
-    if documents is not None:
-        docs = stride_documents(documents, process_index, process_count)
-    else:
-        tokenizer = tokenizer or get_tokenizer()
-        docs = _document_tokens(tokenizer, process_index, process_count)
-    yield from pack_token_stream(docs, batch_size, seq_len)
+    Thin wrapper over :class:`FinewebStream` (kept for call-site compat)."""
+    return FinewebStream(
+        batch_size, seq_len, tokenizer,
+        process_index=process_index, process_count=process_count,
+        documents=documents,
+    )

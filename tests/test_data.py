@@ -121,3 +121,120 @@ def test_fineweb_batch_iterator_strides_injected_documents():
     assert set(b0.ravel()).isdisjoint(set(b1.ravel()))
     # Process 0 packs docs 0,2,...; process 1 packs docs 1,3,...
     assert b0.ravel()[0] == 0 and b1.ravel()[0] == 10
+
+
+# ---- resumable stream position (round-3 VERDICT weak #5) -------------------
+
+
+class _TailOnlySeq:
+    """Document sequence that REJECTS access to already-consumed docs —
+    proves the resumed stream seeks (like the network path's ds.skip)
+    rather than re-reading from the head."""
+
+    def __init__(self, docs, min_start):
+        self._docs = docs
+        self._min = min_start
+
+    def __getitem__(self, sl):
+        assert isinstance(sl, slice) and (sl.start or 0) >= self._min, (
+            f"resume re-read consumed documents: slice start {sl.start} "
+            f"< first unconsumed {self._min}"
+        )
+        return self._docs[sl]
+
+
+def _docs(n=200, tokens=7):
+    return [list(range(i * tokens, (i + 1) * tokens)) for i in range(n)]
+
+
+def test_fineweb_stream_resume_seeks_and_matches():
+    from dtc_tpu.data.fineweb import FinewebStream
+
+    docs = _docs()
+    s1 = FinewebStream(2, 4, documents=docs)
+    first = [next(s1) for _ in range(6)]
+    pos = s1.position_after(4)
+
+    s2 = FinewebStream(
+        2, 4, documents=_TailOnlySeq(docs, pos["docs_consumed"]), position=pos
+    )
+    np.testing.assert_array_equal(next(s2), first[4])
+    np.testing.assert_array_equal(next(s2), first[5])
+    # And beyond what the original produced: the stream keeps going.
+    assert next(s2).shape == (2, 4)
+
+
+def test_fineweb_stream_resume_multihost_stripe_aligned():
+    from dtc_tpu.data.fineweb import FinewebStream
+
+    docs = _docs(400)
+    kw = dict(process_index=1, process_count=2)
+    s1 = FinewebStream(2, 4, documents=docs, **kw)
+    first = [next(s1) for _ in range(5)]
+    pos = s1.position_after(3)
+    s2 = FinewebStream(
+        2, 4, documents=_TailOnlySeq(docs, pos["docs_consumed"] * 2), position=pos, **kw
+    )
+    np.testing.assert_array_equal(next(s2), first[3])
+    np.testing.assert_array_equal(next(s2), first[4])
+
+
+def test_stream_position_sidecar_roundtrip(tmp_path):
+    from dtc_tpu.utils.checkpoint import CheckpointManager
+
+    mgr = CheckpointManager(str(tmp_path))
+    for step in (2, 4, 6, 8):
+        mgr.save_stream(step, {"position": {"docs_consumed": step, "buffer": [1, 2]},
+                               "stream_index": step})
+    assert mgr.load_stream(8)["position"]["docs_consumed"] == 8
+    assert mgr.load_stream(2) is None, "sidecars pruned to max_to_keep"
+    assert mgr.load_stream(4) is not None
+    mgr.close()
+
+
+# ---- held-out eval split (round-3 VERDICT weak #6) -------------------------
+
+
+def test_holdout_eval_disjoint_from_training():
+    from dtc_tpu.data.fineweb import FinewebStream
+    from dtc_tpu.data.holdout import divert_holdout
+
+    docs = _docs()
+    base = [next(FinewebStream(2, 4, documents=docs)) for _ in range(1)][0]
+    full = []
+    ref = FinewebStream(2, 4, documents=docs)
+    for _ in range(20):
+        full.append(next(ref))
+
+    train_it, eval_set = divert_holdout(
+        FinewebStream(2, 4, documents=docs), every=3, count=4
+    )
+    # Eval set = stream indices {0, 3, 6, 9}; training = everything else.
+    assert len(eval_set) == 4
+    for got, idx in zip(eval_set, (0, 3, 6, 9)):
+        np.testing.assert_array_equal(got, full[idx])
+    train_first = [next(train_it) for _ in range(12)]
+    expect_train = [full[i] for i in range(16) if i not in (0, 3, 6, 9)]
+    for got, want in zip(train_first, expect_train):
+        np.testing.assert_array_equal(got, want)
+    for ev in eval_set:
+        assert not any(np.array_equal(ev, tr) for tr in train_first), (
+            "held-out eval batch leaked into training"
+        )
+    del base
+
+
+def test_stream_index_mapping():
+    from dtc_tpu.data.holdout import (
+        diverted_indices, holdout_stream_index, stream_index_for,
+    )
+
+    every, count = 3, 4  # diverted {0, 3, 6, 9}
+    # train batch 1 (1-based) is stream yield 2 (index 1, after diverted 0)
+    assert holdout_stream_index(1, every, count) == 2
+    assert holdout_stream_index(2, every, count) == 3
+    assert holdout_stream_index(3, every, count) == 5  # skips diverted idx 3
+    # Far past the span: offset is exactly `count`.
+    assert holdout_stream_index(100, every, count) == 104
+    assert stream_index_for(5, set()) == 5
+    assert diverted_indices(2, 3) == {0, 2, 4}

@@ -95,3 +95,78 @@ def test_overflow_raises(model_and_params, tiny_model_cfg):
     prompt = jnp.zeros((1, tiny_model_cfg.max_seq_len - 2), jnp.int32)
     with pytest.raises(ValueError, match="max_seq_len"):
         generate(model, params, prompt, 8)
+
+
+def test_tp_sharded_decode_matches_single_device(model_and_params, tiny_model_cfg):
+    """Greedy decode under a TP mesh (params + KV cache sharded over heads)
+    must be token-for-token identical to single-device decode — round-3
+    VERDICT next #9."""
+    from flax import linen as nn
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    from dtc_tpu.config.schema import MeshConfig
+    from dtc_tpu.parallel.mesh import mesh_from_config
+    from dtc_tpu.parallel.sharding import DEFAULT_RULES, param_specs
+
+    model, params = model_and_params
+    prompt = jax.random.randint(jax.random.PRNGKey(3), (2, 5), 0,
+                                tiny_model_cfg.vocab_size, dtype=jnp.int32)
+    want = generate(model, params, prompt, 8)
+
+    mesh = mesh_from_config("3d", MeshConfig(pipe=1, data=2, model=4))
+    specs = param_specs(params, DEFAULT_RULES)
+    sharded = jax.device_put(
+        params,
+        jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
+                     is_leaf=lambda x: isinstance(x, P)),
+    )
+    with mesh, nn.logical_axis_rules(DEFAULT_RULES):
+        got = generate(model, sharded, prompt, 8)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_top_k_restricts_support(model_and_params, tiny_model_cfg):
+    """With top_k=1, temperature sampling must equal greedy argmax (the
+    filter leaves exactly one token)."""
+    model, params = model_and_params
+    prompt = jnp.ones((2, 4), jnp.int32)
+    greedy = generate(model, params, prompt, 6)
+    k1 = generate(model, params, prompt, 6, jax.random.PRNGKey(0),
+                  temperature=1.0, top_k=1)
+    np.testing.assert_array_equal(np.asarray(k1), np.asarray(greedy))
+
+
+def test_top_p_tiny_equals_greedy_and_filters_compose(model_and_params, tiny_model_cfg):
+    model, params = model_and_params
+    prompt = jnp.ones((2, 4), jnp.int32)
+    greedy = generate(model, params, prompt, 6)
+    # A vanishing nucleus keeps only the argmax token.
+    p_tiny = generate(model, params, prompt, 6, jax.random.PRNGKey(1),
+                      temperature=1.0, top_p=1e-6)
+    np.testing.assert_array_equal(np.asarray(p_tiny), np.asarray(greedy))
+    # Composed filters still sample valid vocab ids deterministically per key.
+    a = generate(model, params, prompt, 6, jax.random.PRNGKey(2),
+                 temperature=0.9, top_k=10, top_p=0.9)
+    b = generate(model, params, prompt, 6, jax.random.PRNGKey(2),
+                 temperature=0.9, top_k=10, top_p=0.9)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert int(jnp.max(a)) < tiny_model_cfg.vocab_size
+
+
+def test_sampling_validation():
+    import pytest as _pytest
+
+    from dtc_tpu.config.schema import ModelConfig
+
+    cfg = ModelConfig(vocab_size=97, d_model=64, n_layers=2, n_heads=4,
+                      d_ff=128, max_seq_len=32)
+    model = GPT(cfg)
+    x = jnp.ones((1, 4), jnp.int32)
+    params = model.init({"params": jax.random.PRNGKey(0)}, x, train=False)["params"]
+    with _pytest.raises(ValueError, match="top_k"):
+        generate(model, params, x, 2, jax.random.PRNGKey(0),
+                 temperature=1.0, top_k=0)
+    with _pytest.raises(ValueError, match="top_p"):
+        generate(model, params, x, 2, jax.random.PRNGKey(0),
+                 temperature=1.0, top_p=1.5)
