@@ -39,7 +39,7 @@ class ModelConfig:
     # --- TPU-native extensions ---
     param_dtype: str = "float32"    # master weights
     compute_dtype: str = "bfloat16"  # MXU-native matmul dtype
-    attention: str = "auto"          # auto | dense | flash | ring
+    attention: str = "auto"          # auto | dense | flash | ring | ulysses
     attention_block_q: int = 512     # flash attention query block
     attention_block_kv: int = 512    # flash attention kv block
     # Rematerialisation policy (HBM <-> FLOPs). bool for back-compat:
@@ -50,14 +50,30 @@ class ModelConfig:
     # re-runs the flash kernel or the qkv projections).
     remat: bool | str = False
     vocab_pad_multiple: int = 128    # pad vocab so the TP-sharded axis tiles evenly
+    # --- Mixture-of-Experts (0 = dense MLP; reference is dense-only) ---
+    moe_experts: int = 0             # experts per block; sharded over "model" (EP)
+    moe_top_k: int = 2               # experts per token
+    moe_capacity_factor: float = 1.25  # slots per expert = ceil(T*k*cf/E)
+    moe_aux_coef: float = 0.01       # load-balance aux loss coefficient
 
     def __post_init__(self) -> None:
         if self.d_model % self.n_heads != 0:
             raise ValueError(
                 f"d_model={self.d_model} not divisible by n_heads={self.n_heads}"
             )
-        if self.attention not in ("auto", "dense", "flash", "ring"):
+        if self.attention not in ("auto", "dense", "flash", "ring", "ulysses"):
             raise ValueError(f"unknown attention impl {self.attention!r}")
+        if self.moe_experts < 0:
+            raise ValueError("moe_experts must be >= 0")
+        if self.moe_experts > 0 and not 1 <= self.moe_top_k <= self.moe_experts:
+            raise ValueError(
+                f"moe_top_k={self.moe_top_k} must be in [1, moe_experts="
+                f"{self.moe_experts}]"
+            )
+        if self.moe_experts > 0 and self.moe_capacity_factor <= 0:
+            raise ValueError(
+                f"moe_capacity_factor must be > 0, got {self.moe_capacity_factor}"
+            )
         if self.remat_mode not in ("none", "block", "block_save_flash", "mlp"):
             raise ValueError(
                 f"unknown remat {self.remat!r}; expected bool, 'none', 'block', "

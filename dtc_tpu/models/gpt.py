@@ -137,8 +137,110 @@ class MLP(nn.Module):
         return h
 
 
+def moe_capacity(t: int, cfg: ModelConfig) -> int:
+    """Slots per expert per batch row: ceil(t * top_k * capacity_factor / E).
+    Shared with tests so the parity reference cannot drift from the model."""
+    import math
+
+    return max(
+        1, math.ceil(t * cfg.moe_top_k * cfg.moe_capacity_factor / cfg.moe_experts)
+    )
+
+
+class MoEMLP(nn.Module):
+    """Mixture-of-Experts FFN with expert parallelism (beyond the reference,
+    which is dense-only — `/root/reference/model/MLP.py`).
+
+    TPU-native design: GShard/Switch-style top-k routing with STATIC
+    capacity slots, expressed entirely as einsums over one-hot dispatch /
+    combine tensors — no gathers, no dynamic shapes; everything rides the
+    MXU and jit-compiles once. Expert tensors carry an "experts" logical
+    axis mapped to the "model" mesh axis, so XLA's partitioner emits the
+    expert-parallel all-to-alls (tokens to their experts' devices and back)
+    exactly as it emits TP collectives — EP is a rule-table entry, not a
+    hand-written comm schedule. Tokens over an expert's capacity are
+    dropped (contribute zero; the residual stream carries them — standard
+    Switch semantics). The load-balance aux loss (Switch eq. 4-6,
+    coefficient pre-applied) is sowed into the "aux_loss" collection; the
+    train step adds it to the CE loss.
+    """
+
+    cfg: ModelConfig
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        cfg = self.cfg
+        e, k = cfg.moe_experts, cfg.moe_top_k
+        cdtype = _dtype(cfg.compute_dtype)
+        b, t, d = x.shape
+        cap = moe_capacity(t, cfg)
+
+        wi = self.param(
+            "wi", nn.initializers.lecun_normal(), (e, d, cfg.d_ff),
+            _dtype(cfg.param_dtype),
+        )
+        bi = self.param("bi", nn.initializers.zeros_init(), (e, cfg.d_ff),
+                        _dtype(cfg.param_dtype))
+        wo = self.param(
+            "wo", nn.initializers.lecun_normal(), (e, cfg.d_ff, d),
+            _dtype(cfg.param_dtype),
+        )
+        bo = self.param("bo", nn.initializers.zeros_init(), (e, d),
+                        _dtype(cfg.param_dtype))
+
+        # Routing in fp32 (softmax numerics), per batch row.
+        logits = nn.Dense(
+            e, name="router", use_bias=False,
+            dtype=jnp.float32, param_dtype=jnp.float32,
+        )(x.astype(jnp.float32))
+        probs = jax.nn.softmax(logits, axis=-1)              # (B,T,E)
+        gates, idx = jax.lax.top_k(probs, k)                 # (B,T,k)
+        gates = gates / jnp.sum(gates, axis=-1, keepdims=True)
+
+        dispatch = jnp.zeros((b, t, e, cap), jnp.float32)
+        combine = jnp.zeros((b, t, e, cap), jnp.float32)
+        counts = jnp.zeros((b, e), jnp.float32)
+        picked = jnp.zeros((b, t, e), jnp.float32)
+        for j in range(k):
+            m = jax.nn.one_hot(idx[..., j], e, dtype=jnp.float32)   # (B,T,E)
+            picked = picked + m
+            # Slot index within the expert: running count over the sequence
+            # plus everything earlier routing choices already claimed.
+            pos = jnp.cumsum(m, axis=1) - m + counts[:, None, :]
+            keep = jnp.where(pos < cap, m, 0.0)
+            slot = jax.nn.one_hot(pos.astype(jnp.int32), cap) * keep[..., None]
+            dispatch = dispatch + slot
+            combine = combine + slot * gates[..., j][..., None, None]
+            counts = counts + jnp.sum(m, axis=1)
+
+        # Switch load-balance loss: E * sum_e f_e * P_e, f_e = fraction of
+        # routing choices to e, P_e = mean router probability of e.
+        f = jnp.mean(picked, axis=(0, 1)) / k
+        p_mean = jnp.mean(probs, axis=(0, 1))
+        self.sow(
+            "aux_loss", "load_balance",
+            cfg.moe_aux_coef * e * jnp.sum(f * p_mean),
+        )
+
+        x_e = jnp.einsum("btec,btd->becd", dispatch.astype(cdtype), x)
+        x_e = nn.with_logical_constraint(x_e, ("batch", "experts", None, "embed"))
+        h = nn.gelu(
+            jnp.einsum("becd,edf->becf", x_e, wi.astype(cdtype))
+            + bi.astype(cdtype)[None, :, None, :]
+        )
+        y_e = (
+            jnp.einsum("becf,efd->becd", h, wo.astype(cdtype))
+            + bo.astype(cdtype)[None, :, None, :]
+        )
+        y_e = nn.with_logical_constraint(y_e, ("batch", "experts", None, "embed"))
+        y = jnp.einsum("btec,becd->btd", combine.astype(cdtype), y_e)
+        return nn.with_logical_constraint(y, ("batch", "seq", "embed"))
+
+
 class Block(nn.Module):
-    """Pre-LN transformer block: x + Attn(LN(x)); x + MLP(LN(x))."""
+    """Pre-LN transformer block: x + Attn(LN(x)); x + MLP(LN(x)) — the MLP
+    is the dense reference FFN or, with ``moe_experts > 0``, the
+    expert-parallel :class:`MoEMLP`."""
 
     cfg: ModelConfig
 
@@ -155,15 +257,25 @@ class Block(nn.Module):
             CausalSelfAttention(cfg, name="attn")(h, train=train, decode=decode)
         )
         h = ln("ln_2")(x).astype(_dtype(cfg.compute_dtype))
-        mlp_cls = MLP
-        if cfg.remat_mode == "mlp" and train and not decode:
-            # Selective remat: only the MLP's d_ff-wide intermediates are
-            # recomputed in backward; the attention path's flash-kernel
-            # residuals (q/k/v/out/lse) stay saved, so the backward scan
-            # skips the ~0.7 ms/layer attention recompute the "block" mode
-            # pays (measured, PERF.md round 4).
-            mlp_cls = nn.remat(MLP, prevent_cse=False)
-        x = x + nn.Dropout(cfg.dropout, deterministic=not train)(mlp_cls(cfg, name="mlp")(h))
+        if cfg.moe_experts > 0:
+            moe_cls = MoEMLP
+            if cfg.remat_mode == "mlp" and train and not decode:
+                # Same selective-remat contract as the dense branch: the
+                # (B, E, cap, d_ff) expert intermediates are the memory to
+                # trade away.
+                moe_cls = nn.remat(MoEMLP, prevent_cse=False)
+            ff = moe_cls(cfg, name="moe")(h)
+        else:
+            mlp_cls = MLP
+            if cfg.remat_mode == "mlp" and train and not decode:
+                # Selective remat: only the MLP's d_ff-wide intermediates
+                # are recomputed in backward; the attention path's
+                # flash-kernel residuals (q/k/v/out/lse) stay saved, so the
+                # backward scan skips the ~0.7 ms/layer attention recompute
+                # the "block" mode pays (measured, PERF.md round 4).
+                mlp_cls = nn.remat(MLP, prevent_cse=False)
+            ff = mlp_cls(cfg, name="mlp")(h)
+        x = x + nn.Dropout(cfg.dropout, deterministic=not train)(ff)
         return nn.with_logical_constraint(x, ("batch", "seq", "embed"))
 
 
@@ -264,7 +376,7 @@ class GPTStage(nn.Module):
             cls = nn.remat(cls, **kwargs)
         scanned = nn.scan(
             cls,
-            variable_axes={"params": 0, "cache": 0},
+            variable_axes={"params": 0, "cache": 0, "aux_loss": 0},
             split_rngs={"params": True, "dropout": True},
             length=self.n_layers,
             metadata_params={nn.PARTITION_NAME: "layers"},
@@ -361,10 +473,14 @@ def param_count(cfg: ModelConfig) -> int:
     """Exact parameter count from config (no tracing needed)."""
     d, v, L, f, s = cfg.d_model, cfg.padded_vocab_size, cfg.n_layers, cfg.d_ff, cfg.max_seq_len
     embed = v * d + s * d
+    if cfg.moe_experts > 0:
+        e = cfg.moe_experts
+        ffn = d * e + e * (d * f + f + f * d + d)  # router + E experts
+    else:
+        ffn = (d * f + f) + (f * d + d)            # fc1 + fc2
     per_block = (
         4 * (d * d + d)        # q,k,v,out projections
-        + (d * f + f)          # fc1
-        + (f * d + d)          # fc2
+        + ffn
         + 4 * d                # ln_1, ln_2 scale+bias
     )
     head = 2 * d + (d * v + v)  # ln_f + lm_head

@@ -99,4 +99,8 @@ def causal_attention(
         from dtc_tpu.ops.ring_attention import ring_causal_attention
 
         return ring_causal_attention(q, k, v)
+    if impl == "ulysses":
+        from dtc_tpu.ops.ulysses_attention import ulysses_causal_attention
+
+        return ulysses_causal_attention(q, k, v, block_q=block_q, block_kv=block_kv)
     raise ValueError(f"unknown attention impl {impl!r}")

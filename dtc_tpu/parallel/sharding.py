@@ -50,6 +50,12 @@ DEFAULT_RULES: tuple[tuple[str, str | None], ...] = (
     ("vocab_in", None),       # wte rows (gather-indexed; kept replicated)
     ("seqpos", None),         # wpe rows
     ("microbatch", None),     # leading microbatch axis of PP inputs
+    # Expert parallelism (MoE): the expert axis of activations and of
+    # expert params shards over "model" — XLA emits the token<->expert
+    # all-to-alls from these two entries alone. The experts' d_ff axis
+    # stays unsharded (one mesh axis cannot shard two axes of one tensor).
+    ("experts", "model"),     # expert axis of dispatch/combine activations
+    ("experts_p", "model"),   # expert axis of expert PARAMS (EP memory win)
 )
 
 #: FSDP / ZeRO-3: every parameter's d_model axis shards over the SAME mesh
@@ -140,6 +146,12 @@ PARAM_AXES_TABLE: tuple[tuple[tuple[str, ...], tuple[str | None, ...]], ...] = (
     (("fc1", "bias"), ("layers", "mlp")),
     (("fc2", "kernel"), ("layers", "mlp", "embed_p")),
     (("fc2", "bias"), ("layers", "embed_p")),
+    # --- MoE (moe_experts > 0): router replicated, experts EP-sharded ---
+    (("moe", "router", "kernel"), ("layers", "embed_p", None)),
+    (("moe", "wi"), ("layers", "experts_p", "embed_p", None)),
+    (("moe", "bi"), ("layers", "experts_p", None)),
+    (("moe", "wo"), ("layers", "experts_p", None, "embed_p")),
+    (("moe", "bo"), ("layers", "experts_p", "embed_p")),
 )
 
 

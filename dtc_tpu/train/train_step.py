@@ -77,9 +77,15 @@ def create_gspmd_train_step(
         def loss_fn(params: PyTree) -> jax.Array:
             # targets route the head through the fused head+CE op: same loss
             # value bitwise, one logits pass fewer in backward (fused_ce.py).
-            return state.apply_fn(
-                {"params": params}, x, train=True, rngs={"dropout": rng}, targets=y
+            # "aux_loss" carries MoE load-balance terms (coefficient already
+            # applied at sow time); empty for dense models.
+            loss, mut = state.apply_fn(
+                {"params": params}, x, train=True, rngs={"dropout": rng},
+                targets=y, mutable=["aux_loss"],
             )
+            for leaf in jax.tree.leaves(mut.get("aux_loss", {})):
+                loss = loss + jnp.sum(leaf)
+            return loss
 
         loss, grads = jax.value_and_grad(loss_fn)(state.params)
         state = state.apply_gradients(grads=grads)

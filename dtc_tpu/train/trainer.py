@@ -190,22 +190,33 @@ def train(
         # ZeRO-3 parameter sharding: same mesh, same batch layout, but
         # parameter storage shards over "data" (see sharding.FSDP_RULES).
         rules = FSDP_RULES
-    if model_cfg.attention == "ring":
-        if mesh.shape.get("pipe", 1) > 1:
+    if model_cfg.moe_experts > 0 and mesh.shape.get("pipe", 1) > 1:
+        # The pipeline step computes loss via per-stage applies that do not
+        # thread the sowed "aux_loss" collection; rather than silently
+        # training without load balancing, refuse.
+        raise ValueError(
+            "MoE (moe_experts > 0) is not supported under pipeline "
+            "parallelism yet; use a mesh with pipe=1 (EP composes with "
+            "DP/TP/FSDP)"
+        )
+    if model_cfg.attention in ("ring", "ulysses"):
+        if model_cfg.attention == "ring" and mesh.shape.get("pipe", 1) > 1:
             # The ring's inner shard_map over "model" cannot nest inside
             # the pipeline's manual region (Shardy rejects re-binding a
             # mesh whose "pipe" axis a parent manual computation owns).
-            # Sequence parallelism composes with DP/TP, not PP.
+            # Ring composes with DP/TP, not PP — Ulysses (pure GSPMD
+            # constraints, no nested shard_map) composes with PP too.
             raise ValueError(
                 "attention='ring' (sequence parallelism) cannot run under "
                 "pipeline parallelism; use a mesh with pipe=1 (ring "
-                "composes with the data axis)"
+                "composes with the data axis) or attention='ulysses'"
             )
         if not caller_rules:
-            # Ring attention repurposes the "model" mesh axis for sequence
-            # parallelism: derive the ring table from whatever base is
-            # active (DEFAULT or FSDP), swapping seq onto "model" and the
-            # Megatron TP axes off it.
+            # Both sequence-parallel schemes repurpose the "model" mesh
+            # axis: derive the table from whatever base is active (DEFAULT
+            # or FSDP), swapping seq onto "model" and the Megatron TP axes
+            # off it. Ulysses re-shards heads over "model" INSIDE the
+            # attention op only.
             rules = ring_rules_from(rules)
     lead = is_lead_process()
     if lead:
