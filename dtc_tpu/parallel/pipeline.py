@@ -251,18 +251,26 @@ def create_pp_train_step(
                 valid = jnp.logical_and(mb_idx >= 0, mb_idx < m)
                 h_in = lax.dynamic_index_in_dim(h0, jnp.minimum(tick, m - 1), keepdims=False)
                 h_cur = jnp.where(is_first, h_in, h_buf)
-                h_stage = stage_mod.apply(
+                # mutable aux_loss: MoE load-balance terms sowed by this
+                # stage's layers (empty for dense models). Masked by
+                # validity and averaged over microbatches below, so the
+                # total matches the GSPMD step's per-batch aux at M=1.
+                h_stage, mut = stage_mod.apply(
                     {"params": stage_p}, h_cur, train=True,
                     rngs={"dropout": pp_dropout_rng(rng, stage_id, tick + 1)},
+                    mutable=["aux_loss"],
                 )
+                from dtc_tpu.train.train_step import sum_aux_loss
+
+                aux = jnp.where(valid, sum_aux_loss(mut), 0.0)
                 h_out = jnp.where(valid, h_stage, h_zeros)
                 if num_stages == 1:
                     h_next = h_zeros
                 else:
                     h_next = lax.ppermute(h_out, "pipe", perm)
-                return h_next, h_out
+                return h_next, (h_out, aux)
 
-            _, h_ticks = lax.scan(body, h_zeros, jnp.arange(n_ticks))
+            _, (h_ticks, aux_ticks) = lax.scan(body, h_zeros, jnp.arange(n_ticks))
 
             # 3) Head + loss after the scan (seq-chunked over pipe). Return
             # the LOCAL loss (this stage's partial). Each device seeds AD
@@ -274,7 +282,7 @@ def create_pp_train_step(
             # all-reduce of a constant, an op with no data dependencies
             # that concurrency-aware schedulers may hoist into a race with
             # the ring collectives).
-            return head_loss(head_p, h_ticks)
+            return head_loss(head_p, h_ticks) + jnp.sum(aux_ticks) / m
 
         local_loss, grads = jax.value_and_grad(loss_fn, argnums=(0, 1, 2))(
             params["embed"], stage_params, params["head"]
@@ -497,11 +505,18 @@ def create_1f1b_train_step(
         def stage_fn(stage_p, h_in, jf):
             """Stage chunk for (traced) microbatch jf; rng unique per
             (stage, microbatch) — 1F1B tick numbering differs from GPipe's,
-            so keys derive from the microbatch index, not the tick."""
-            return stage_mod.apply(
+            so keys derive from the microbatch index, not the tick.
+            Returns (h_out, aux): MoE load-balance terms sowed by this
+            stage's layers (zero for dense models); the backward slot seeds
+            the aux cotangent explicitly."""
+            from dtc_tpu.train.train_step import sum_aux_loss
+
+            h_out, mut = stage_mod.apply(
                 {"params": stage_p}, h_in, train=True,
                 rngs={"dropout": pp_dropout_rng(rng, stage_id, jf + 1)},
+                mutable=["aux_loss"],
             )
+            return h_out, sum_aux_loss(mut)
 
         # Running state. Activations and cotangents live in S-slot ring
         # buffers keyed by microbatch % S: the schedule allows multi-tick
@@ -551,8 +566,9 @@ def create_1f1b_train_step(
             slot = jnp.where(valid_f, jf % num_stages, 0)
             h_arrived = lax.dynamic_index_in_dim(buf, slot, keepdims=False)
             h_in = jnp.where(is_first, h0, h_arrived)
-            h_out = stage_fn(stage_params, h_in, jnp.maximum(jf, 0))
+            h_out, aux_f = stage_fn(stage_params, h_in, jnp.maximum(jf, 0))
             h_out = jnp.where(valid_f, h_out, h_zeros)
+            loss = loss + jnp.where(valid_f, aux_f, 0.0) / m
             # Stash h_in for the backward recompute (same slot; for
             # stages > 0 this re-writes the delivered value, for stage 0 it
             # stores the embed output).
@@ -583,7 +599,11 @@ def create_1f1b_train_step(
                     lambda sp, h: stage_fn(sp, h, jnp.maximum(jb, 0)),
                     stage_params, h_saved,
                 )
-                dsp, dh_prev = stage_vjp(g_in.astype(cdtype))
+                # Seed both outputs: the activation cotangent from the ring
+                # (or head) and the aux-loss cotangent 1/m for valid slots
+                # (the forward added aux/m to the loss).
+                aux_seed = jnp.where(valid_b, 1.0 / m, 0.0)
+                dsp, dh_prev = stage_vjp((g_in.astype(cdtype), aux_seed))
                 g_stage = jax.tree.map(jnp.add, g_stage, dsp)
                 # Cotangent leaving stage 0 is the embed output's: feed the
                 # cooperative embed VJP (static mb from the table).

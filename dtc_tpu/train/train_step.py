@@ -37,6 +37,16 @@ class Batch:
     y: jax.Array
 
 
+def sum_aux_loss(mutated: dict) -> jax.Array:
+    """Total of the sowed "aux_loss" collection (MoE load-balance terms,
+    coefficient pre-applied; zero for dense models). One definition shared
+    by the GSPMD step and both pipeline schedules."""
+    total = jnp.zeros((), jnp.float32)
+    for leaf in jax.tree.leaves(mutated.get("aux_loss", {})):
+        total = total + jnp.sum(leaf)
+    return total
+
+
 def cross_entropy_loss(logits: jax.Array, targets: jax.Array) -> jax.Array:
     """Mean next-token cross entropy, float32, gather-free.
 
@@ -83,9 +93,7 @@ def create_gspmd_train_step(
                 {"params": params}, x, train=True, rngs={"dropout": rng},
                 targets=y, mutable=["aux_loss"],
             )
-            for leaf in jax.tree.leaves(mut.get("aux_loss", {})):
-                loss = loss + jnp.sum(leaf)
-            return loss
+            return loss + sum_aux_loss(mut)
 
         loss, grads = jax.value_and_grad(loss_fn)(state.params)
         state = state.apply_gradients(grads=grads)

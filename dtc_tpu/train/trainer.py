@@ -190,15 +190,6 @@ def train(
         # ZeRO-3 parameter sharding: same mesh, same batch layout, but
         # parameter storage shards over "data" (see sharding.FSDP_RULES).
         rules = FSDP_RULES
-    if model_cfg.moe_experts > 0 and mesh.shape.get("pipe", 1) > 1:
-        # The pipeline step computes loss via per-stage applies that do not
-        # thread the sowed "aux_loss" collection; rather than silently
-        # training without load balancing, refuse.
-        raise ValueError(
-            "MoE (moe_experts > 0) is not supported under pipeline "
-            "parallelism yet; use a mesh with pipe=1 (EP composes with "
-            "DP/TP/FSDP)"
-        )
     if model_cfg.attention in ("ring", "ulysses"):
         if model_cfg.attention == "ring" and mesh.shape.get("pipe", 1) > 1:
             # The ring's inner shard_map over "model" cannot nest inside
