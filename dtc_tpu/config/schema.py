@@ -162,6 +162,10 @@ class TrainConfig:
     # at dropout=0 — with dropout the schedules draw different, equally
     # valid masks, see create_1f1b_train_step).
     pp_schedule: str = "gpipe"
+    # Virtual (interleaved) stages per device for pp_schedule: 1f1b —
+    # Megatron-style: V model chunks per device shrink the fill bubble to
+    # chunk-sized steps. Requires n_layers % (pipe * virtual) == 0.
+    pp_virtual_stages: int = 1
     mesh: MeshConfig = field(default_factory=MeshConfig)
     dataset: str = "fineweb"     # fineweb | synthetic
     warmup_steps: int = 5        # untimed warmup steps (reference uses 5)
@@ -196,6 +200,13 @@ class TrainConfig:
             raise ValueError("pp_microbatches must be >= 1")
         if self.pp_schedule not in ("gpipe", "1f1b"):
             raise ValueError(f"unknown pp_schedule {self.pp_schedule!r}")
+        if self.pp_virtual_stages < 1:
+            raise ValueError("pp_virtual_stages must be >= 1")
+        if self.pp_virtual_stages > 1 and self.pp_schedule != "1f1b":
+            raise ValueError(
+                "pp_virtual_stages > 1 (interleaved scheduling) requires "
+                "pp_schedule: 1f1b"
+            )
         if self.eval_holdout_every < 1:
             raise ValueError("eval_holdout_every must be >= 1")
         if self.prng_impl not in ("threefry2x32", "rbg", "unsafe_rbg"):

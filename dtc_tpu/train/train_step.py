@@ -131,16 +131,19 @@ def create_train_step(
     num_microbatches: int = 1,
     rules: Sequence[tuple[str, str | None]] = DEFAULT_RULES,
     pp_schedule: str = "gpipe",
+    pp_virtual: int = 1,
 ):
     """Strategy-dispatching factory: GSPMD step, or pipeline step when the
-    mesh has a non-trivial ``pipe`` axis (GPipe or 1F1B per ``pp_schedule``)."""
+    mesh has a non-trivial ``pipe`` axis (GPipe, or plain/interleaved 1F1B
+    per ``pp_schedule`` / ``pp_virtual``)."""
     if mesh.shape.get("pipe", 1) > 1:
         assert model is not None, "pipeline step needs the model for staged apply"
         if pp_schedule == "1f1b":
             from dtc_tpu.parallel.pipeline import create_1f1b_train_step
 
             return create_1f1b_train_step(
-                model, mesh, num_microbatches=num_microbatches, rules=rules
+                model, mesh, num_microbatches=num_microbatches, rules=rules,
+                virtual=pp_virtual,
             )
         from dtc_tpu.parallel.pipeline import create_pp_train_step
 

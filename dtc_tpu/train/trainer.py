@@ -155,7 +155,9 @@ def init_state(
     )(init_rng, dummy)["params"]
     pp = mesh.shape.get("pipe", 1) > 1
     if pp:
-        params = pp_stack_params(params, mesh.shape["pipe"])
+        params = pp_stack_params(
+            params, mesh.shape["pipe"], train_cfg.pp_virtual_stages
+        )
         specs = pp_param_specs(params, rules)
     else:
         specs = param_specs(params, rules)
@@ -243,6 +245,7 @@ def train(
         train_step = create_train_step(
             mesh, model=model, num_microbatches=train_cfg.pp_microbatches,
             rules=rules, pp_schedule=train_cfg.pp_schedule,
+            pp_virtual=train_cfg.pp_virtual_stages,
         )
 
         # Resume parity: the interrupted run consumed warmup_steps +
@@ -417,7 +420,7 @@ def train(
 
             params = state.params
             if mesh.shape.get("pipe", 1) > 1:
-                params = pp_unstack_params(params)
+                params = pp_unstack_params(params, train_cfg.pp_virtual_stages)
             vals = [
                 float(jax.device_get(eval_fn(params, Batch(x=x, y=y))))
                 for x, y in eval_set
