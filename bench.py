@@ -33,6 +33,11 @@ def run_config(
     bench_steps: int = 30,
     n_heads: int = 16,
     max_seq_len: int = 512,
+    moe_experts: int = 0,
+    attention_block_q: int = 512,
+    attention_block_kv: int = 512,
+    attention_block_q_bwd: int = 0,
+    attention_block_kv_bwd: int = 0,
 ):
     import jax
     import jax.numpy as jnp
@@ -52,6 +57,11 @@ def run_config(
         vocab_size=50258, d_model=512, n_layers=12, n_heads=n_heads, d_ff=2048,
         max_seq_len=max_seq_len, dropout=0.1, param_dtype="float32",
         compute_dtype="bfloat16", attention="auto", remat=remat,
+        moe_experts=moe_experts,
+        attention_block_q=attention_block_q,
+        attention_block_kv=attention_block_kv,
+        attention_block_q_bwd=attention_block_q_bwd,
+        attention_block_kv_bwd=attention_block_kv_bwd,
     )
     opt_cfg = OptimConfig(lr=3e-4, weight_decay=0.1, grad_clip=1.0)
     train_cfg = TrainConfig(
@@ -103,6 +113,68 @@ def run_config(
     }
 
 
+def ring_block_smoke() -> dict:
+    """Execute the zigzag-ring Pallas BLOCK kernels on the real chip.
+
+    The ring itself needs >= 2 devices (the whole-ring VJP short-circuits
+    to dense on this 1-chip box, and CPU tests run the kernels in
+    interpret mode), but the four per-device kernel flavors the ring is
+    built from — fwd/bwd x causal/cross-chunk — are ordinary single-chip
+    pallas_calls. Compiling and running them here pins the Mosaic path
+    every round (round-4 VERDICT weak #5): parity vs an fp32 jnp oracle,
+    on-device.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from dtc_tpu.ops import flash_attention as fa
+
+    b, tc, h, d = 2, 512, 16, 32
+    g = fa._packed_group(d, h)
+    scale = float(d**-0.5)
+    kq, kk, kv, kd = jax.random.split(jax.random.PRNGKey(7), 4)
+    q = jax.random.normal(kq, (b, tc, h, d), jnp.float32)
+    k = jax.random.normal(kk, (b, tc, h, d), jnp.float32)
+    v = jax.random.normal(kv, (b, tc, h, d), jnp.float32)
+    do = jax.random.normal(kd, (b, tc, h, d), jnp.float32)
+    pk = lambda x: x.reshape(b, tc, h * d)
+
+    def oracle(q, k, v, causal):
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+        if causal:
+            mask = jnp.tril(jnp.ones((tc, tc), bool))
+            s = jnp.where(mask, s, -1e9)
+        p = jax.nn.softmax(s, axis=-1)
+        return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+
+    res = {}
+    for causal in (True, False):
+        tag = "causal" if causal else "cross"
+        fwd = jax.jit(lambda q, k, v, c=causal: fa._block_call(
+            pk(q), pk(k), pk(v), scale, c, g, d))
+        out, lse = fwd(q, k, v)
+        ref = oracle(q, k, v, causal)
+        res[f"fwd_{tag}_err"] = float(
+            jnp.max(jnp.abs(out.reshape(b, tc, h, d) - ref))
+        )
+        bwd = jax.jit(lambda q, k, v, do, o, lse, c=causal: fa._block_call(
+            pk(q), pk(k), pk(v), scale, c, g, d, do=pk(do), o=o, lse=lse))
+        dq, dk, dv = bwd(q, k, v, do, out, lse)
+        g_ref = jax.jit(jax.grad(
+            lambda q, k, v, c=causal: jnp.sum(oracle(q, k, v, c) * do),
+            argnums=(0, 1, 2),
+        ))(q, k, v)
+        for name, got, ref_g in zip("qkv", (dq, dk, dv), g_ref):
+            err = float(jnp.max(jnp.abs(
+                got.reshape(b, tc, h, d) - ref_g
+            )) / (jnp.max(jnp.abs(ref_g)) + 1e-8))
+            res[f"bwd_{tag}_d{name}_err"] = round(err, 6)
+        res[f"fwd_{tag}_err"] = round(res[f"fwd_{tag}_err"], 6)
+    res["ok"] = bool(np.all([e < 5e-3 for kk_, e in res.items() if kk_ != "ok"]))
+    return res
+
+
 def main() -> None:
     import jax
 
@@ -113,9 +185,33 @@ def main() -> None:
     # ceiling (PERF.md "Why 40% is out of reach for THIS model shape").
     hd128 = run_config(batch=32, remat="block_save_flash", prng_impl="rbg", n_heads=4)
     # Long-context: 8x the flagship sequence through the flash kernel.
+    # Tiling from the round-5 on-chip sweep (PERF.md): the forward wants
+    # wide KV blocks, the fused backward a square 512 tile.
     long_ctx = run_config(
         batch=4, remat="block_save_flash", prng_impl="rbg", max_seq_len=4096,
-        bench_steps=10,
+        bench_steps=10, attention_block_kv=1024,
+        attention_block_q_bwd=512, attention_block_kv_bwd=512,
+    )
+    # T=8192: exercises the packed SPLIT backward (fused dk/dv scratches
+    # exceed VMEM past T=4096) — the shape that had no packed path before
+    # round 5.
+    long_ctx_8k = run_config(
+        batch=2, remat="block_save_flash", prng_impl="rbg", max_seq_len=8192,
+        bench_steps=8, attention_block_kv=1024,
+        attention_block_q_bwd=512, attention_block_kv_bwd=1024,
+    )
+    # Same long-context budget at an MXU-friendly head shape (head_dim=128):
+    # the hd32 row's gap to peak is the workload's lane bound, not the
+    # kernels' (PERF.md round-5 ceiling analysis).
+    long_ctx_hd128 = run_config(
+        batch=4, remat="block_save_flash", prng_impl="rbg", max_seq_len=4096,
+        bench_steps=10, n_heads=4,
+    )
+    # MoE: flagship dims with an E=8 top-2 expert FFN (Switch-style einsum
+    # dispatch; MFU uses the MoE-structural FLOP count, metrics.py).
+    moe = run_config(
+        batch=32, remat="block_save_flash", prng_impl="rbg", moe_experts=8,
+        bench_steps=15,
     )
 
     result = {
@@ -132,6 +228,10 @@ def main() -> None:
         "tuned_b32_remat": tuned,
         "mxu_hd128_b32_remat": hd128,
         "long_context_t4096_b4": long_ctx,
+        "long_context_t8192_b2": long_ctx_8k,
+        "long_context_t4096_b4_hd128": long_ctx_hd128,
+        "moe_e8_top2_b32": moe,
+        "ring_block_smoke": ring_block_smoke(),
         "mfu": tuned["mfu"],  # honest per-chip utilization on the REFERENCE shape
         "mfu_hd128": hd128["mfu"],
     }

@@ -42,6 +42,12 @@ class ModelConfig:
     attention: str = "auto"          # auto | dense | flash | ring | ulysses
     attention_block_q: int = 512     # flash attention query block
     attention_block_kv: int = 512    # flash attention kv block
+    # Backward-pass tiling overrides (0 = same as forward). At long
+    # context the forward wants wide KV blocks (fewer online-softmax
+    # stat updates) while the fused backward's dk/dv scratches cap its
+    # tile budget — measured on v5e (PERF.md round 5).
+    attention_block_q_bwd: int = 0
+    attention_block_kv_bwd: int = 0
     # Rematerialisation policy (HBM <-> FLOPs). bool for back-compat:
     # False/"none" saves all activations, True/"block" checkpoints each
     # whole block, "mlp" checkpoints only the MLP (drops the d_ff-wide
@@ -55,6 +61,14 @@ class ModelConfig:
     moe_top_k: int = 2               # experts per token
     moe_capacity_factor: float = 1.25  # slots per expert = ceil(T*k*cf/E)
     moe_aux_coef: float = 0.01       # load-balance aux loss coefficient
+    # Dev knob: emit checkify.check guards for traced invariants that
+    # cannot raise at trace time (currently the decode-cache write
+    # frontier, whose dynamic_update_slice would otherwise CLAMP on
+    # overflow and corrupt logits silently). Callers that apply the model
+    # directly must discharge via jax.experimental.checkify; the
+    # generate() API discharges them automatically (its static length
+    # validation already makes them unreachable from that path).
+    debug_checks: bool = False
 
     def __post_init__(self) -> None:
         if self.d_model % self.n_heads != 0:
@@ -184,10 +198,24 @@ class TrainConfig:
     # see dtc_tpu/data/holdout.py. Ignored for synthetic (disjoint seeds).
     eval_holdout_every: int = 10
     resume: bool = True          # resume from latest checkpoint if present
+    # Refuse to truncate an existing <output_dir>/log.csv on a FRESH run
+    # (start_step == 0) unless this is set. Guards the committed
+    # outputs/ comparison artifact against being silently clobbered by a
+    # smoke run pointed at the wrong directory (round-4 VERDICT weak #1:
+    # a 3-step run overwrote the 2000-step outputs/dp member). Resuming
+    # from a checkpoint is always allowed — the log is rewritten from the
+    # restored step as part of the documented resume semantics.
+    overwrite: bool = False
     profile_start: int = 0       # capture jax.profiler trace [start, stop)
     profile_stop: int = 0
     multihost: bool = False      # call jax.distributed.initialize()
     prng_impl: str = "threefry2x32"  # dropout PRNG; "rbg" is ~4% faster on TPU
+    # Dev-config NaN sanitizer (SURVEY §5): enables jax_debug_nans for the
+    # duration of the run — any jitted computation producing NaN re-runs
+    # un-jitted and raises FloatingPointError at the offending primitive
+    # instead of training on garbage. Costly (per-step output checks);
+    # keep off in perf runs.
+    debug_nans: bool = False
 
     def __post_init__(self) -> None:
         if self.parallel not in VALID_PARALLEL:

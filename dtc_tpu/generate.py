@@ -60,12 +60,7 @@ def _top_p_mask(logits: jax.Array, p: float) -> jax.Array:
     return jnp.where(logits < cutoff, -jnp.inf, logits)
 
 
-@functools.partial(
-    jax.jit,
-    static_argnums=(0, 3),
-    static_argnames=("temperature", "top_k", "top_p"),
-)
-def generate(
+def _generate_impl(
     model,
     params: PyTree,
     prompt: jax.Array,
@@ -146,3 +141,46 @@ def generate(
         body, (mutated["cache"], first, rng), None, length=max_new_tokens - 1
     )
     return jnp.concatenate([first[:, None], rest.T], axis=1)
+
+
+_generate_jit = functools.partial(
+    jax.jit,
+    static_argnums=(0, 3),
+    static_argnames=("temperature", "top_k", "top_p"),
+)(_generate_impl)
+
+
+def generate(
+    model,
+    params: PyTree,
+    prompt: jax.Array,
+    max_new_tokens: int,
+    rng: jax.Array | None = None,
+    *,
+    temperature: float = 0.0,
+    top_k: int | None = None,
+    top_p: float | None = None,
+) -> jax.Array:
+    """See :func:`_generate_impl` for semantics; this wrapper picks the
+    compiled path. With ``cfg.debug_checks`` the model emits
+    ``checkify.check`` guards (decode-cache overflow), which must be
+    functionalized before jit — this path discharges them and throws,
+    trading per-call recompiles for dev-mode assertions. The static
+    length validation above makes the check unreachable from THIS API;
+    it protects direct ``model.apply(..., decode=True)`` callers."""
+    if getattr(model.cfg, "debug_checks", False):
+        from jax.experimental import checkify
+
+        def f(params, prompt, rng):
+            return _generate_impl(
+                model, params, prompt, max_new_tokens, rng,
+                temperature=temperature, top_k=top_k, top_p=top_p,
+            )
+
+        err, out = jax.jit(checkify.checkify(f))(params, prompt, rng)
+        err.throw()
+        return out
+    return _generate_jit(
+        model, params, prompt, max_new_tokens, rng,
+        temperature=temperature, top_k=top_k, top_p=top_p,
+    )

@@ -84,6 +84,19 @@ class CausalSelfAttention(nn.Module):
             )
             ci = self.variable("cache", "index", lambda: jnp.zeros((), jnp.int32))
             idx = ci.value
+            if cfg.debug_checks:
+                # The caller contract above, enforced dynamically: callers
+                # bypassing generate() can discharge this via
+                # checkify.checkify instead of debugging clamped writes.
+                from jax.experimental import checkify
+
+                checkify.check(
+                    idx + t <= cfg.max_seq_len,
+                    "decode cache overflow: write frontier {i} + {n} tokens "
+                    "exceeds max_seq_len={m}; dynamic_update_slice would "
+                    "clamp and corrupt the cache",
+                    i=idx, n=jnp.int32(t), m=jnp.int32(cfg.max_seq_len),
+                )
             # Logical constraints shard the cache over heads under a TP
             # mesh (seq stays unsharded, so the dynamic update partitions
             # trivially); decode then runs head-parallel up to out_proj's
@@ -112,6 +125,8 @@ class CausalSelfAttention(nn.Module):
                 impl=cfg.attention,
                 block_q=cfg.attention_block_q,
                 block_kv=cfg.attention_block_kv,
+                block_q_bwd=cfg.attention_block_q_bwd,
+                block_kv_bwd=cfg.attention_block_kv_bwd,
             )
         out = out.reshape(b, t, cfg.d_model)
         out = dense("out_proj")(out)

@@ -76,6 +76,8 @@ def causal_attention(
     impl: str = "auto",
     block_q: int = 512,
     block_kv: int = 512,
+    block_q_bwd: int = 0,
+    block_kv_bwd: int = 0,
 ) -> jax.Array:
     """Dispatch causal self-attention over ``(B, T, H, D)`` tensors."""
     if impl == "auto":
@@ -94,7 +96,10 @@ def causal_attention(
     if impl == "flash":
         from dtc_tpu.ops.flash_attention import flash_causal_attention
 
-        return flash_causal_attention(q, k, v, block_q=block_q, block_kv=block_kv)
+        return flash_causal_attention(
+            q, k, v, block_q=block_q, block_kv=block_kv,
+            block_q_bwd=block_q_bwd, block_kv_bwd=block_kv_bwd,
+        )
     if impl == "ring":
         from dtc_tpu.ops.ring_attention import ring_causal_attention
 
@@ -102,5 +107,8 @@ def causal_attention(
     if impl == "ulysses":
         from dtc_tpu.ops.ulysses_attention import ulysses_causal_attention
 
-        return ulysses_causal_attention(q, k, v, block_q=block_q, block_kv=block_kv)
+        return ulysses_causal_attention(
+            q, k, v, block_q=block_q, block_kv=block_kv,
+            block_q_bwd=block_q_bwd, block_kv_bwd=block_kv_bwd,
+        )
     raise ValueError(f"unknown attention impl {impl!r}")

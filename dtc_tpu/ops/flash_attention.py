@@ -42,11 +42,12 @@ from jax.experimental.pallas import tpu as pltpu
 NEG_INF = -1e9  # matches the reference's additive mask value (ops/attention.py)
 _LANES = 128  # TPU lane width (kept for stat-scratch shapes)
 
-#: Longest sequence routed to the packed kernels. The packed multi-tile
-#: BACKWARD accumulates dk/dv in full-T (T, 128) fp32 VMEM scratches —
-#: ~8 MB of scratch + output blocks at T=4096 (measured working on a v5e);
-#: doubling T again exceeds a core's VMEM, so longer sequences fall back to
-#: the transpose-layout kernels whose scratch is O(block), not O(T).
+#: Longest sequence routed to the FUSED packed backward, which accumulates
+#: dk/dv in full-T (T, 128) fp32 VMEM scratches — ~8 MB of scratch + output
+#: blocks at T=4096 (measured working on a v5e); doubling T again exceeds a
+#: core's VMEM. Past this, the packed SPLIT dq/dkv kernels (scratch
+#: O(block), 7 tile matmuls vs the fused 5) take over — still packed
+#: layout, any T.
 _PACKED_MAX_T = 4096
 
 
@@ -430,12 +431,13 @@ def _packed_group(d: int, h: int) -> int | None:
 
 
 def _packed_scores(qt, kt, sl, scale, mask):
-    """Masked fp32 score tile for head slice ``sl`` of packed q/k tiles."""
+    """fp32 score tile for head slice ``sl`` of packed q/k tiles;
+    ``mask=None`` skips the causal select (fully-below-diagonal blocks)."""
     s = jax.lax.dot_general(
         qt[:, sl] * scale, kt[:, sl], (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32,
     )
-    return jnp.where(mask, s, NEG_INF)
+    return s if mask is None else jnp.where(mask, s, NEG_INF)
 
 
 def _packed_tile_bwd(qt, kt, vt, dot_, ot, lse, mask, sl, scale, delta=None):
@@ -546,9 +548,8 @@ def _fwd_kernel_packed_multi(q_ref, k_ref, v_ref, o_ref, lse_ref,
         l_scr[:] = jnp.zeros_like(l_scr)
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
-    @pl.when(j * block_kv <= i * block_q + block_q - 1)
-    def _():
-        mask = _mask(i, j, block_q, block_kv)
+    def _accumulate(masked: bool):
+        mask = _mask(i, j, block_q, block_kv) if masked else None
         qt, kt, vt = q_ref[0], k_ref[0], v_ref[0]
         for gg in range(g):
             sl = slice(gg * d, (gg + 1) * d)
@@ -564,6 +565,20 @@ def _fwd_kernel_packed_multi(q_ref, k_ref, v_ref, o_ref, lse_ref,
                 preferred_element_type=jnp.float32,
             )
             m_scr[:, cl] = m_new
+
+    # The causal select is a full VPU pass over the fp32 score tile; only
+    # diagonal-straddling blocks need it. Fully-below-diagonal blocks
+    # (last kv pos <= first q pos) run unmasked — at T/block = 8 that is
+    # 28 of 36 valid blocks.
+    straddles = j * block_kv + block_kv - 1 > i * block_q
+
+    @pl.when((j * block_kv <= i * block_q + block_q - 1) & straddles)
+    def _():
+        _accumulate(True)
+
+    @pl.when(jnp.logical_not(straddles))
+    def _():
+        _accumulate(False)
 
     @pl.when(j == pl.num_programs(3) - 1)
     def _():
@@ -609,9 +624,8 @@ def _bwd_kernel_packed_multi(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
         dk_scr[:] = jnp.zeros_like(dk_scr)
         dv_scr[:] = jnp.zeros_like(dv_scr)
 
-    @pl.when(j * block_kv <= i * block_q + block_q - 1)
-    def _():
-        mask = _mask(i, j, block_q, block_kv)
+    def _accumulate(masked: bool):
+        mask = _mask(i, j, block_q, block_kv) if masked else None
         qt, kt, vt = q_ref[0], k_ref[0], v_ref[0]
         dot_, ot = do_ref[0], o_ref[0]
         rows = pl.ds(j * block_kv, block_kv)
@@ -626,6 +640,17 @@ def _bwd_kernel_packed_multi(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
             dk_scr[rows, sl] += dk_c
             dv_scr[rows, sl] += dv_c
 
+    # Mask only where the block straddles the diagonal (see fwd kernel).
+    straddles = j * block_kv + block_kv - 1 > i * block_q
+
+    @pl.when((j * block_kv <= i * block_q + block_q - 1) & straddles)
+    def _():
+        _accumulate(True)
+
+    @pl.when(jnp.logical_not(straddles))
+    def _():
+        _accumulate(False)
+
     @pl.when(j == nkv - 1)
     def _():
         dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
@@ -636,8 +661,184 @@ def _bwd_kernel_packed_multi(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
         dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash_packed(q, k, v, block_q, block_kv, g, d, scale):
+# --- packed split backward: O(block) scratch, any T -----------------------
+#
+# The fused multi-tile backward above holds full-length (T, 128) dk/dv
+# accumulators in VMEM — past _PACKED_MAX_T those outgrow a core's VMEM.
+# These two kernels are the FA2-style split on the packed layout: the dq
+# kernel accumulates (block_q, 128) while walking KV blocks, the dk/dv
+# kernel accumulates (block_kv, 128) while walking Q blocks. Each
+# recomputes p from the saved lse (7 tile matmuls total vs the fused
+# kernel's 5), so the fused path stays the default wherever it fits and
+# these take over beyond it. delta = rowsum(dO ⊙ O) is precomputed by XLA
+# in the lse layout (b, hg, T, g) — one cheap elementwise+reduce pass —
+# instead of per-tile, which would redo it nkv (dq) / nq (dkv) times.
+
+
+def _split_tile_p_ds(refs, lse_ref, delta_ref, mask, sl, gg, scale):
+    """Shared split-kernel recompute for head slice ``sl``: returns
+    (p, ds, qs) — probabilities from the saved lse, the score gradient
+    ds = p * (dp - delta), and the pre-scaled q tile. One definition so
+    the dq and dk/dv halves of the gradient cannot drift apart."""
+    q_ref, k_ref, v_ref, do_ref = refs
+    qs = q_ref[0][:, sl] * scale
+    s = jax.lax.dot_general(
+        qs, k_ref[0][:, sl], (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    p = jnp.exp(s - lse_ref[0, 0, :, gg : gg + 1])
+    if mask is not None:
+        p = jnp.where(mask, p, 0.0)
+    dp = jax.lax.dot_general(
+        do_ref[0][:, sl], v_ref[0][:, sl], (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    ds = p * (dp - delta_ref[0, 0, :, gg : gg + 1])
+    return p, ds, qs
+
+
+def _dq_kernel_packed(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                      dq_ref, dq_scr, *, block_q, block_kv, g, d, scale):
+    i, j = pl.program_id(2), pl.program_id(3)
+
+    @pl.when(j == 0)
+    def _():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    def _accumulate(masked: bool):
+        mask = _mask(i, j, block_q, block_kv) if masked else None
+        for gg in range(g):
+            sl = slice(gg * d, (gg + 1) * d)
+            _, ds, _ = _split_tile_p_ds(
+                (q_ref, k_ref, v_ref, do_ref), lse_ref, delta_ref,
+                mask, sl, gg, scale,
+            )
+            kk = k_ref[0][:, sl]
+            dq_scr[:, sl] += jax.lax.dot_general(
+                ds.astype(kk.dtype), kk, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ) * scale
+
+    straddles = j * block_kv + block_kv - 1 > i * block_q
+
+    @pl.when((j * block_kv <= i * block_q + block_q - 1) & straddles)
+    def _():
+        _accumulate(True)
+
+    @pl.when(jnp.logical_not(straddles))
+    def _():
+        _accumulate(False)
+
+    @pl.when(j == pl.num_programs(3) - 1)
+    def _():
+        dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _dkv_kernel_packed(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                       dk_ref, dv_ref, dk_scr, dv_scr,
+                       *, block_q, block_kv, g, d, scale):
+    j, i = pl.program_id(2), pl.program_id(3)  # kv block outer, q inner
+
+    @pl.when(i == 0)
+    def _():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    def _accumulate(masked: bool):
+        mask = _mask(i, j, block_q, block_kv) if masked else None
+        for gg in range(g):
+            sl = slice(gg * d, (gg + 1) * d)
+            p, ds, qs = _split_tile_p_ds(
+                (q_ref, k_ref, v_ref, do_ref), lse_ref, delta_ref,
+                mask, sl, gg, scale,
+            )
+            dk_scr[:, sl] += jax.lax.dot_general(
+                ds.astype(qs.dtype), qs, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            dot_ = do_ref[0]
+            dv_scr[:, sl] += jax.lax.dot_general(
+                p.astype(dot_.dtype), dot_[:, sl], (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+
+    straddles = j * block_kv + block_kv - 1 > i * block_q
+
+    @pl.when((j * block_kv <= i * block_q + block_q - 1) & straddles)
+    def _():
+        _accumulate(True)
+
+    @pl.when(jnp.logical_not(straddles))
+    def _():
+        _accumulate(False)
+
+    @pl.when(i == pl.num_programs(3) - 1)
+    def _():
+        dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _packed_split_bwd_call(q, k, v, do, out, lse, block_q, block_kv, g, d, scale):
+    b, t, hd = q.shape
+    hg = hd // _LANES
+    nq, nkv = t // block_q, t // block_kv
+    # delta in the lse layout (b, hg, t, g): rowsum over each head's d slice.
+    delta = (
+        (do.astype(jnp.float32) * out.astype(jnp.float32))
+        .reshape(b, t, hg, g, d)
+        .sum(-1)
+        .transpose(0, 2, 1, 3)
+    )
+
+    qspec = pl.BlockSpec((1, block_q, _LANES), lambda bi, gi, i, j: (bi, i, gi))
+    kvspec = pl.BlockSpec((1, block_kv, _LANES), lambda bi, gi, i, j: (bi, j, gi))
+    statspec = pl.BlockSpec((1, 1, block_q, g), lambda bi, gi, i, j: (bi, gi, i, 0))
+    dq = pl.pallas_call(
+        functools.partial(
+            _dq_kernel_packed,
+            block_q=block_q, block_kv=block_kv, g=g, d=d, scale=scale,
+        ),
+        grid=(b, hg, nq, nkv),
+        in_specs=[qspec, kvspec, kvspec, qspec, statspec, statspec],
+        out_specs=qspec,
+        out_shape=jax.ShapeDtypeStruct((b, t, hd), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, _LANES), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
+        ),
+        interpret=_interpret(),
+    )(q, k, v, do, lse, delta)
+
+    qspec_kv = pl.BlockSpec((1, block_q, _LANES), lambda bi, gi, j, i: (bi, i, gi))
+    kvspec_kv = pl.BlockSpec((1, block_kv, _LANES), lambda bi, gi, j, i: (bi, j, gi))
+    statspec_kv = pl.BlockSpec((1, 1, block_q, g), lambda bi, gi, j, i: (bi, gi, i, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _dkv_kernel_packed,
+            block_q=block_q, block_kv=block_kv, g=g, d=d, scale=scale,
+        ),
+        grid=(b, hg, nkv, nq),
+        in_specs=[qspec_kv, kvspec_kv, kvspec_kv, qspec_kv, statspec_kv, statspec_kv],
+        out_specs=[kvspec_kv, kvspec_kv],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, t, hd), k.dtype),
+            jax.ShapeDtypeStruct((b, t, hd), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_kv, _LANES), jnp.float32),
+            pltpu.VMEM((block_kv, _LANES), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
+        ),
+        interpret=_interpret(),
+    )(q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
+def _flash_packed(q, k, v, block_q, block_kv, g, d, scale,
+                  block_q_bwd, block_kv_bwd):
     out, _ = _packed_fwd_call(q, k, v, block_q, block_kv, g, d, scale)
     return out
 
@@ -694,7 +895,8 @@ def _packed_fwd_call(q, k, v, block_q, block_kv, g, d, scale):
     )(q, k, v)
 
 
-def _packed_flash_fwd(q, k, v, block_q, block_kv, g, d, scale):
+def _packed_flash_fwd(q, k, v, block_q, block_kv, g, d, scale,
+                      block_q_bwd, block_kv_bwd):
     out, lse = _packed_fwd_call(q, k, v, block_q, block_kv, g, d, scale)
     # Policy-saveable residuals — see _flash_fwd for the rationale.
     out = checkpoint_name(out, "flash_out")
@@ -705,10 +907,21 @@ def _packed_flash_fwd(q, k, v, block_q, block_kv, g, d, scale):
     return out, (q, k, v, out, lse)
 
 
-def _packed_flash_bwd(block_q, block_kv, g, d, scale, res, do):
+def _packed_flash_bwd(block_q, block_kv, g, d, scale,
+                      block_q_bwd, block_kv_bwd, res, do):
     q, k, v, out, lse = res
     b, t, hd = q.shape
     hg = hd // _LANES
+    # The backward's best tiling differs from the forward's (the fused
+    # kernel holds dk/dv scratches the forward doesn't; measured on v5e,
+    # PERF.md round 5): nonzero overrides retile it independently —
+    # including OUT of the single-tile fast path, so the knob is honored
+    # uniformly. The saved lse is blocked afresh by these specs, so any
+    # valid tiling of the same arrays works.
+    if block_q_bwd:
+        block_q = block_q_bwd
+    if block_kv_bwd:
+        block_kv = block_kv_bwd
     nq = t // block_q
     if block_kv == t and nq == 1:
         dspec, kvspec = _packed_specs(t, block_q)
@@ -731,6 +944,12 @@ def _packed_flash_bwd(block_q, block_kv, g, d, scale, res, do):
             interpret=_interpret(),
         )(q, k, v, do, out, lse)
         return dq, dk, dv
+    if t > _PACKED_MAX_T:
+        # Fused kernel's full-T dk/dv VMEM scratches don't fit: split
+        # dq / dkv kernels with O(block) scratch take over.
+        return _packed_split_bwd_call(
+            q, k, v, do, out, lse, block_q, block_kv, g, d, scale
+        )
     nkv = t // block_kv
     qspec = pl.BlockSpec((1, block_q, _LANES), lambda bi, gi, i, j: (bi, i, gi))
     kvspec = pl.BlockSpec((1, block_kv, _LANES), lambda bi, gi, i, j: (bi, j, gi))
@@ -779,33 +998,46 @@ def supports(t: int, d: int, block_q: int, block_kv: int) -> bool:
 def flash_causal_attention(
     q: jax.Array, k: jax.Array, v: jax.Array,
     *, block_q: int = 512, block_kv: int = 512,
+    block_q_bwd: int = 0, block_kv_bwd: int = 0,
 ) -> jax.Array:
     """Causal flash attention over ``(B, T, H, D)`` tensors (op-layer layout).
 
     Exact (up to fp32 accumulation order) match of
     ``dense_causal_attention``; O(T) memory instead of O(T²).
+    ``block_*_bwd`` retile the packed backward independently of the
+    forward (0 = same as forward) — at long context the forward wants
+    wide KV blocks while the backward's scratches cap its tile budget.
     """
     b, t, h, d = q.shape
     block_q, block_kv = min(block_q, t), min(block_kv, t)
+    block_q_bwd, block_kv_bwd = min(block_q_bwd, t), min(block_kv_bwd, t)
     if not supports(t, d, block_q, block_kv):
         raise ValueError(
             f"flash attention unsupported for T={t}, D={d}, "
             f"block_q={block_q}, block_kv={block_kv}"
         )
+    if (block_q_bwd or block_kv_bwd) and not supports(
+        t, d, block_q_bwd or block_q, block_kv_bwd or block_kv
+    ):
+        raise ValueError(
+            f"flash attention backward tiling unsupported for T={t}, "
+            f"block_q_bwd={block_q_bwd}, block_kv_bwd={block_kv_bwd}"
+        )
 
     g = _packed_group(d, h)
-    if g is not None and t <= _PACKED_MAX_T:
+    if g is not None:
         # Packed transpose-free path: heads group into 128-lane blocks ->
         # operate on the model-native (B, T, H*D) layout directly. reshape
         # is a bitcast; no HBM relayout anywhere. Single-tile shapes use
         # the one-pass kernels; tiled shapes the online-softmax/causal-
-        # block-skipping ones. Beyond _PACKED_MAX_T the tiled backward's
-        # full-T dk/dv scratches outgrow VMEM and the transpose path (all
-        # scratch O(block)) takes over.
+        # block-skipping ones. Beyond _PACKED_MAX_T the fused backward's
+        # full-T dk/dv scratches outgrow VMEM and the split dq/dkv
+        # kernels (all scratch O(block)) take over — packed at every T.
         scale = float(d ** -0.5)
         out = _flash_packed(
             q.reshape(b, t, h * d), k.reshape(b, t, h * d),
             v.reshape(b, t, h * d), block_q, block_kv, g, d, scale,
+            block_q_bwd, block_kv_bwd,
         )
         return out.reshape(b, t, h, d)
 

@@ -43,6 +43,8 @@ def ulysses_causal_attention(
     axis_name: str = "model",
     block_q: int = 512,
     block_kv: int = 512,
+    block_q_bwd: int = 0,
+    block_kv_bwd: int = 0,
 ) -> jax.Array:
     """Causal attention over ``(B, T, H, D)`` with T sharded over
     ``axis_name`` on entry/exit and H sharded inside. Call under an active
@@ -68,6 +70,9 @@ def ulysses_causal_attention(
     # seq-sharded -> head-sharded: XLA inserts the all-to-all.
     head_spec = P(None, None, axis_name, None)
     q, k, v = (jax.lax.with_sharding_constraint(x, head_spec) for x in (q, k, v))
-    out = causal_attention(q, k, v, impl="auto", block_q=block_q, block_kv=block_kv)
+    out = causal_attention(
+        q, k, v, impl="auto", block_q=block_q, block_kv=block_kv,
+        block_q_bwd=block_q_bwd, block_kv_bwd=block_kv_bwd,
+    )
     # head-sharded -> seq-sharded: the inverse all-to-all.
     return jax.lax.with_sharding_constraint(out, P(None, axis_name, None, None))

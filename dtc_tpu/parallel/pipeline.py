@@ -528,6 +528,24 @@ def create_1f1b_train_step(
 
     rows, kf, kb = simulate_interleaved(m, num_stages, v_count)
     n_ticks = len(rows)
+    # The tick loop is a Python unroll: program size — and with it trace +
+    # XLA compile time — grows with n_ticks. Measured on this class of
+    # host (scripts/compile_curve_1f1b.py, S=4, V=1): 19 ticks -> 40 s
+    # trace+compile, 33 -> 78 s, 61 -> 191 s — compile grows superlinearly
+    # (~2.3 s/tick at M=32 vs ~1.4 at M=8). Past ~96 ticks compilation is
+    # minutes-to-tens-of-minutes; fail loudly instead of hanging in XLA.
+    # GPipe (autodiff through a lax.scan clock, O(1) program size) is the
+    # supported schedule for very large M — its bubble *ratio* at large M
+    # is the same and its activation memory is the price (docstring).
+    if n_ticks > 96:
+        raise ValueError(
+            f"1f1b schedule has {n_ticks} ticks (microbatches={m}, "
+            f"stages={num_stages}, virtual={v_count}); the unrolled program "
+            "past ~96 ticks takes minutes to compile (measured curve in "
+            "scripts/compile_curve_1f1b.py / PERF.md). Use pp_schedule: "
+            "gpipe for very large microbatch counts, or reduce "
+            "pp_microbatches / pp_virtual_stages."
+        )
 
     if v_count == 1:
         # No chunk ever wraps the ring, so skip the S-1 -> 0 edge.

@@ -180,6 +180,36 @@ def train(
     host_iterator: Iterator[np.ndarray] | None = None,
     rules=DEFAULT_RULES,
 ) -> TrainResult:
+    if not train_cfg.debug_nans:
+        return _train(
+            train_cfg, model_cfg, opt_cfg,
+            host_iterator=host_iterator, rules=rules,
+        )
+    # SURVEY §5 sanitizer row: the TPU-native analog of the reference
+    # stack's device-side assert tooling. XLA re-runs any jitted
+    # computation whose output contains NaN un-jitted and raises
+    # FloatingPointError at the producing primitive — so a NaN in e.g.
+    # the fused-CE backward surfaces as a traceback, not a silently
+    # garbage loss. Dev-config only: the re-run check syncs every step.
+    prev = jax.config.jax_debug_nans
+    jax.config.update("jax_debug_nans", True)
+    try:
+        return _train(
+            train_cfg, model_cfg, opt_cfg,
+            host_iterator=host_iterator, rules=rules,
+        )
+    finally:
+        jax.config.update("jax_debug_nans", prev)
+
+
+def _train(
+    train_cfg: TrainConfig,
+    model_cfg: ModelConfig,
+    opt_cfg: OptimConfig,
+    *,
+    host_iterator: Iterator[np.ndarray] | None = None,
+    rules=DEFAULT_RULES,
+) -> TrainResult:
     maybe_initialize_distributed(train_cfg.multihost)
     num_devices = jax.device_count()
     mesh = mesh_from_config(
@@ -357,8 +387,30 @@ def train(
         )
 
         result = TrainResult(state=state, mesh=mesh)
+        log_path = os.path.join(train_cfg.output_dir, "log.csv")
+        clobber = bool(
+            train_cfg.output_dir
+            and lead
+            and start_step == 0
+            and not train_cfg.overwrite
+            and os.path.exists(log_path)
+        )
+        if jax.process_count() > 1:
+            # Only the lead writes (and may see) the artifact; broadcast its
+            # verdict so every host raises — a lead-only raise would leave
+            # the others hung on the first training collective.
+            from jax.experimental import multihost_utils
+
+            clobber = bool(multihost_utils.broadcast_one_to_all(clobber))
+        if clobber:
+            raise ValueError(
+                f"refusing to overwrite existing {log_path} on a fresh run; "
+                "pass overwrite: true, pick another output_dir, or enable "
+                "checkpointing so the run resumes instead (guards committed "
+                "comparison artifacts against stray smoke runs)"
+            )
         csv = (
-            CSVLogger(os.path.join(train_cfg.output_dir, "log.csv"))
+            CSVLogger(log_path)
             if train_cfg.output_dir and lead
             else None
         )

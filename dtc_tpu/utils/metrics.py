@@ -52,8 +52,47 @@ def gpt_step_flops(cfg: ModelConfig, batch: int, seq_len: int) -> float:
     return dense + attn
 
 
+def moe_step_flops(cfg: ModelConfig, batch: int, seq_len: int) -> float:
+    """Training FLOPs/step for the MoE model (``moe_experts > 0``).
+
+    Counts the matmul work the step actually schedules (6ND-style: fwd
+    2x + bwd 4x per MAC), with the dense FFN term replaced by the MoE
+    block's four structural matmuls: router, dispatch/combine einsums
+    (contraction over T — real MXU work, see PERF.md round 5), and the
+    E-expert FFN over the static capacity slots. Capacity slack means
+    E*cap >= k*T slots run regardless of how many are filled — that
+    overhead is the einsum-dispatch design's price and is counted, so the
+    MFU here is hardware utilization, not "useful-token" utilization.
+    """
+    from dtc_tpu.models.gpt import moe_capacity
+
+    assert cfg.moe_experts > 0
+    e, cap, d, ff = cfg.moe_experts, moe_capacity(seq_len, cfg), cfg.d_model, cfg.d_ff
+    tokens = batch * seq_len
+    # Dense accounting minus the router/expert params — a token does NOT
+    # visit every expert, so their FLOPs are counted structurally below,
+    # not via 6N.
+    n = param_count(cfg)
+    n_matmul = n - cfg.padded_vocab_size * cfg.d_model - cfg.max_seq_len * cfg.d_model
+    n_moe = cfg.n_layers * (d * e + e * 2 * d * ff)
+    dense = 6.0 * (n_matmul - n_moe) * tokens
+    attn = 12.0 * cfg.n_layers * batch * (seq_len**2) * d / 2.0
+    per_layer_moe = (
+        2.0 * batch * seq_len * d * e              # router
+        + 2.0 * 2.0 * batch * seq_len * e * cap * d  # dispatch + combine
+        + 2.0 * 2.0 * batch * e * cap * d * ff       # wi + wo
+    )
+    moe = 3.0 * cfg.n_layers * per_layer_moe       # fwd + 2x bwd
+    return dense + attn + moe
+
+
 def mfu(cfg: ModelConfig, batch: int, seq_len: int, step_time_s: float, n_chips: int) -> float | None:
     peak = peak_flops_per_chip()
     if peak is None or step_time_s <= 0:
         return None
-    return gpt_step_flops(cfg, batch, seq_len) / (step_time_s * peak * n_chips)
+    flops = (
+        moe_step_flops(cfg, batch, seq_len)
+        if cfg.moe_experts > 0
+        else gpt_step_flops(cfg, batch, seq_len)
+    )
+    return flops / (step_time_s * peak * n_chips)

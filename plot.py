@@ -12,7 +12,7 @@ from __future__ import annotations
 
 import os
 
-STRATEGIES = ("dp", "tp", "pp", "3d", "fsdp", "tpu_dp")
+STRATEGIES = ("dp", "tp", "pp", "3d", "fsdp", "moe", "tpu_dp")
 
 
 def main(output_root: str = "outputs") -> None:

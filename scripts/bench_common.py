@@ -17,7 +17,8 @@ if REPO not in sys.path:
 
 
 def build_step(batch=32, heads=16, max_seq_len=512, dropout=0.1, remat=True,
-               grad_clip=1.0, weight_decay=0.1):
+               grad_clip=1.0, weight_decay=0.1, block_q=512, block_kv=512,
+               block_q_bwd=0, block_kv_bwd=0, moe_experts=0):
     """Returns (step_fn, state, batch_obj, key, (mesh, rules), model_cfg)
     for the flagship GPT-89.6M train step with the given knobs."""
     import jax
@@ -36,6 +37,9 @@ def build_step(batch=32, heads=16, max_seq_len=512, dropout=0.1, remat=True,
         vocab_size=50258, d_model=512, n_layers=12, n_heads=heads, d_ff=2048,
         max_seq_len=max_seq_len, dropout=dropout, param_dtype="float32",
         compute_dtype="bfloat16", attention="auto", remat=remat,
+        attention_block_q=block_q, attention_block_kv=block_kv,
+        attention_block_q_bwd=block_q_bwd, attention_block_kv_bwd=block_kv_bwd,
+        moe_experts=moe_experts,
     )
     opt_cfg = OptimConfig(lr=3e-4, weight_decay=weight_decay, grad_clip=grad_clip)
     train_cfg = TrainConfig(

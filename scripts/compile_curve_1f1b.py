@@ -1,0 +1,81 @@
+"""Measure 1F1B trace+compile time vs microbatch count M (PERF.md data).
+
+The 1F1B tick loop is a Python unroll: traced-program size grows with the
+tick count (M + S - 1 forward ticks plus drain for V=1). This script
+measures where compile time knees on an 8-virtual-device CPU mesh
+(pipe=4 x data=2) so the guard in create_1f1b_train_step can carry a
+measured number instead of a guess.
+
+Usage: JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+       python scripts/compile_curve_1f1b.py [--ms 8 16 32 64]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--ms", type=int, nargs="+", default=[8, 16, 32, 64])
+    ap.add_argument("--virtual", type=int, default=1)
+    args = ap.parse_args()
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    from flax import linen as nn
+
+    from dtc_tpu.config.schema import MeshConfig, ModelConfig, OptimConfig, TrainConfig
+    from dtc_tpu.models.gpt import GPT
+    from dtc_tpu.parallel.mesh import mesh_from_config
+    from dtc_tpu.parallel.pipeline import simulate_interleaved
+    from dtc_tpu.parallel.sharding import DEFAULT_RULES
+    from dtc_tpu.train.train_step import Batch, create_train_step
+    from dtc_tpu.train.trainer import init_state
+
+    model_cfg = ModelConfig(
+        vocab_size=97, d_model=64, n_layers=4, n_heads=4, d_ff=128,
+        max_seq_len=32, dropout=0.0, param_dtype="float32",
+        compute_dtype="float32", attention="dense",
+    )
+    opt_cfg = OptimConfig(lr=1e-3, weight_decay=0.1, grad_clip=1.0)
+    mesh = mesh_from_config("3d", MeshConfig(pipe=4, data=2, model=1))
+
+    for m in args.ms:
+        train_cfg = TrainConfig(
+            seed=0, parallel="3d", batch=2 * m, steps=1, log_every=1,
+            output_dir="", pp_microbatches=m, pp_schedule="1f1b",
+            pp_virtual_stages=args.virtual,
+            mesh=MeshConfig(pipe=4, data=2, model=1), dataset="synthetic",
+        )
+        n_ticks = len(simulate_interleaved(m, 4, args.virtual)[0])
+        model = GPT(model_cfg)
+        with mesh, nn.logical_axis_rules(DEFAULT_RULES):
+            state = init_state(model, model_cfg, train_cfg, opt_cfg, mesh, DEFAULT_RULES)
+            step = create_train_step(
+                mesh, model=model, num_microbatches=m, rules=DEFAULT_RULES,
+                pp_schedule="1f1b", pp_virtual=args.virtual,
+            )
+            x = jnp.zeros((2 * m, 32), jnp.int32)
+            batch = Batch(x=x, y=x)
+            key = jax.random.key(0)
+            t0 = time.perf_counter()
+            lowered = step.lower(state, batch, key)
+            t_trace = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            lowered.compile()
+            t_compile = time.perf_counter() - t0
+        print(f"M={m:3d} V={args.virtual} ticks={n_ticks:4d}  "
+              f"trace {t_trace:7.1f} s  compile {t_compile:7.1f} s", flush=True)
+
+
+if __name__ == "__main__":
+    main()

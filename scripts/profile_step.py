@@ -18,14 +18,17 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
 
-def run(batch: int, heads: int, steps: int, trace_dir: str, remat: bool) -> float:
+def run(batch: int, heads: int, steps: int, trace_dir: str, remat: bool,
+        seq: int = 512, block_q: int = 512, block_kv: int = 512,
+        moe_experts: int = 0) -> float:
     from bench_common import time_step
 
     # Trace `steps` iterations (trace size), but always time the full
     # 20-iteration protocol PERF.md numbers use.
     return time_step(
         steps=20, trace_dir=trace_dir, trace_steps=steps,
-        batch=batch, heads=heads, remat=remat,
+        batch=batch, heads=heads, remat=remat, max_seq_len=seq,
+        block_q=block_q, block_kv=block_kv, moe_experts=moe_experts,
     )
 
 
@@ -62,12 +65,23 @@ def parse(trace_dir: str, steps: int, top: int):
 if __name__ == "__main__":
     ap = argparse.ArgumentParser()
     ap.add_argument("--batch", type=int, default=32)
+    ap.add_argument("--seq", type=int, default=512)
+    ap.add_argument("--block-q", type=int, default=512)
+    ap.add_argument("--block-kv", type=int, default=512)
     ap.add_argument("--heads", type=int, default=16)
+    ap.add_argument("--moe-experts", type=int, default=0)
     ap.add_argument("--steps", type=int, default=6)
     ap.add_argument("--top", type=int, default=40)
-    ap.add_argument("--no-remat", action="store_true")
+    ap.add_argument(
+        "--remat", default="block_save_flash",
+        choices=["none", "block", "block_save_flash", "mlp"],
+        help="remat mode (default matches bench.py's tuned/long-context configs)",
+    )
     ap.add_argument("--trace-dir", default="/tmp/dtc_trace")
     args = ap.parse_args()
-    step_ms = run(args.batch, args.heads, args.steps, args.trace_dir, not args.no_remat)
+    remat = False if args.remat == "none" else args.remat
+    step_ms = run(args.batch, args.heads, args.steps, args.trace_dir,
+                  remat, seq=args.seq, block_q=args.block_q,
+                  block_kv=args.block_kv, moe_experts=args.moe_experts)
     print(f"# measured step time: {step_ms:.2f} ms")
     parse(args.trace_dir, args.steps, args.top)

@@ -46,14 +46,17 @@ def main() -> None:
     model_cfg = ModelConfig(
         vocab_size=50258, d_model=512, n_layers=12, n_heads=16, d_ff=2048,
         max_seq_len=512, dropout=0.1, param_dtype="float32",
-        compute_dtype="bfloat16", attention="auto",
+        compute_dtype="bfloat16", attention="auto", remat="block_save_flash",
     )
     opt_cfg = OptimConfig(lr=3e-4, weight_decay=0.1, grad_clip=1.0)
     train_cfg = TrainConfig(
-        seed=0, parallel="dp", batch=8, steps=args.steps, log_every=50,
+        seed=0, parallel="dp", batch=32, steps=args.steps, log_every=50,
         output_dir="outputs/tpu_resume", dataset="synthetic", warmup_steps=5,
         prefetch=2, prng_impl="rbg", sync_every_step=False,
         checkpoint_every=1000, resume=True, eval_every=2500, eval_batches=4,
+        # Fresh interrupt phase legitimately restarts this artifact; the
+        # resume phase enters via start_step > 0 and never needs the flag.
+        overwrite=True,
     )
     result = train(train_cfg, model_cfg, opt_cfg)
     print(f"final loss: {result.losses[-1]:.12f}")

@@ -42,25 +42,34 @@ STRATEGIES = {
     "pp": dict(parallel="pp", pp_microbatches=4, mesh={}),
     "3d": dict(parallel="3d", pp_microbatches=4, mesh=dict(pipe=2, data=2, model=2)),
     "fsdp": dict(parallel="fsdp", pp_microbatches=1, mesh={}),
+    # MoE/EP: E=8 experts sharded one-per-device over model=8 (Switch
+    # top-2). A different model than the rows above — its loss curve is
+    # NOT expected to overlap them; it demonstrates the EP training path
+    # end-to-end at artifact scale.
+    "moe": dict(
+        parallel="tp", pp_microbatches=1, mesh={},
+        model=dict(moe_experts=8, moe_top_k=2),
+    ),
 }
 
 
 def run_cpu_strategy(name: str, steps: int) -> None:
     """One strategy to completion in a subprocess on 8 virtual CPU devices."""
     spec = STRATEGIES[name]
+    model_kw = {**CPU_MODEL, **spec.get("model", {})}
     code = f"""
 import jax
 jax.config.update("jax_platforms", "cpu")
 from dtc_tpu.config.schema import MeshConfig, ModelConfig, OptimConfig, TrainConfig
 from dtc_tpu.train.trainer import train
 
-model_cfg = ModelConfig(**{CPU_MODEL!r})
+model_cfg = ModelConfig(**{model_kw!r})
 opt_cfg = OptimConfig(lr=3e-4, weight_decay=0.1, grad_clip=1.0)
 train_cfg = TrainConfig(
     seed=0, parallel={spec['parallel']!r}, batch=8, steps={steps}, log_every=50,
     output_dir={os.path.join('outputs', name)!r},
     pp_microbatches={spec['pp_microbatches']}, mesh=MeshConfig(**{spec['mesh']!r}),
-    dataset="synthetic", warmup_steps=5, prefetch=2,
+    dataset="synthetic", warmup_steps=5, prefetch=2, overwrite=True,
 )
 train(train_cfg, model_cfg, opt_cfg)
 """
@@ -77,10 +86,12 @@ train(train_cfg, model_cfg, opt_cfg)
 
 
 def run_tpu_flagship(steps: int) -> None:
-    """Flagship GPT-89.6M reference workload (batch 8 x seq 512) on the
-    attached TPU chip. Rows at log_every boundaries (and the final total)
-    are device-synced times; intermediate rows are dispatch stamps (see
-    sync_every_step below)."""
+    """Flagship GPT-89.6M on the attached TPU chip, at the tuned round-4/5
+    configuration (batch 32, ``remat="block_save_flash"``, fused head-CE,
+    rbg dropout — the bench.py ``tuned_b32_remat`` config, MFU 0.42).
+    Rows at log_every boundaries (and the final total) are device-synced
+    times; intermediate rows are dispatch stamps (see sync_every_step
+    below)."""
     code = f"""
 from dtc_tpu.config.schema import MeshConfig, ModelConfig, OptimConfig, TrainConfig
 from dtc_tpu.train.trainer import train
@@ -88,13 +99,13 @@ from dtc_tpu.train.trainer import train
 model_cfg = ModelConfig(
     vocab_size=50258, d_model=512, n_layers=12, n_heads=16, d_ff=2048,
     max_seq_len=512, dropout=0.1, param_dtype="float32",
-    compute_dtype="bfloat16", attention="auto",
+    compute_dtype="bfloat16", attention="auto", remat="block_save_flash",
 )
 opt_cfg = OptimConfig(lr=3e-4, weight_decay=0.1, grad_clip=1.0)
 train_cfg = TrainConfig(
-    seed=0, parallel="dp", batch=8, steps={steps}, log_every=50,
+    seed=0, parallel="dp", batch=32, steps={steps}, log_every=50,
     output_dir="outputs/tpu_dp", dataset="synthetic", warmup_steps=5,
-    prefetch=2, prng_impl="rbg",
+    prefetch=2, prng_impl="rbg", overwrite=True,
     # This box reaches its TPU through a network tunnel where a per-step
     # device sync costs ~0.14 s of pure RTT (5x the actual 37 ms step).
     # With sync off, the trainer still re-stamps every 50th row (and the

@@ -1,0 +1,66 @@
+"""Dev-config sanitizers (SURVEY §5 race/assert tooling analog).
+
+The reference stack's debugging story is device-side asserts + sanitizer
+builds; the TPU-native analogs are ``jax_debug_nans`` (re-run jitted
+computations whose outputs contain NaN and raise at the producing
+primitive) and ``checkify`` guards on traced invariants that cannot raise
+at trace time. Both are opt-in config fields, off in perf runs.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import pytest
+from jax.experimental import checkify
+
+from dtc_tpu.generate import init_cache
+from dtc_tpu.models.gpt import GPT
+from dtc_tpu.train.trainer import train
+
+
+def test_debug_nans_raises_instead_of_garbage(
+    train_cfg_factory, tiny_model_cfg, opt_cfg
+):
+    """lr=NaN poisons params on the first update; with the knob on, the
+    next step raises FloatingPointError instead of logging NaN losses."""
+    bad_opt = dataclasses.replace(opt_cfg, lr=float("nan"))
+
+    # Baseline failure mode: silently trains on garbage.
+    cfg = train_cfg_factory("dp", steps=2)
+    res = train(cfg, tiny_model_cfg, bad_opt)
+    assert not jnp.isfinite(jnp.asarray(res.losses[-1]))
+
+    with pytest.raises(FloatingPointError):
+        train(
+            dataclasses.replace(cfg, debug_nans=True),
+            tiny_model_cfg, bad_opt,
+        )
+    # The knob must not leak into later runs in the same process.
+    assert jax.config.jax_debug_nans is False
+
+
+def test_debug_checks_catch_decode_cache_overflow(tiny_model_cfg):
+    """models/gpt.py decode caller contract: total decoded length must stay
+    <= max_seq_len, else dynamic_update_slice clamps and corrupts logits
+    silently. With debug_checks, a checkified apply raises instead."""
+    cfg = dataclasses.replace(tiny_model_cfg, max_seq_len=8, debug_checks=True)
+    model = GPT(cfg)
+    x = jnp.ones((1, 4), jnp.int32)
+    params = model.init({"params": jax.random.PRNGKey(0)}, x, train=False)["params"]
+    cache = init_cache(model, 1)
+
+    def prefill(cache, toks):
+        return model.apply(
+            {"params": params, "cache": cache}, toks,
+            train=False, decode=True, mutable=["cache"],
+        )
+
+    checked = checkify.checkify(prefill)
+    # Within bound: 6 of 8 positions — no error.
+    err, (_, mut) = checked(cache, jnp.ones((1, 6), jnp.int32))
+    err.throw()
+    # Overflow: frontier 6 + 4 tokens > 8 — must raise, not clamp.
+    err, _ = checked(mut["cache"], jnp.ones((1, 4), jnp.int32))
+    with pytest.raises(checkify.JaxRuntimeError, match="decode cache overflow"):
+        err.throw()

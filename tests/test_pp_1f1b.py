@@ -172,3 +172,18 @@ def test_interleaved_config_validation(train_cfg_factory):
         train_cfg_factory("pp", pp_virtual_stages=2)  # gpipe default
     with pytest.raises(ValueError, match="pp_virtual_stages"):
         train_cfg_factory("pp", pp_schedule="1f1b", pp_virtual_stages=0)
+
+
+def test_1f1b_tick_cap_raises(tiny_model_cfg):
+    """Round-4 VERDICT #4: the unrolled tick loop must refuse schedules
+    whose compile time is minutes (measured curve in
+    scripts/compile_curve_1f1b.py) instead of hanging in XLA."""
+    from dtc_tpu.parallel.mesh import mesh_from_config
+    from dtc_tpu.parallel.pipeline import create_1f1b_train_step
+    from dtc_tpu.models.gpt import GPT
+
+    mesh = mesh_from_config("3d", MeshConfig(pipe=4, data=2, model=1))
+    with pytest.raises(ValueError, match="ticks"):
+        create_1f1b_train_step(
+            GPT(tiny_model_cfg), mesh, num_microbatches=128,
+        )
