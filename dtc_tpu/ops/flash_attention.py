@@ -430,6 +430,26 @@ def _packed_group(d: int, h: int) -> int | None:
     return g if h % g == 0 else None
 
 
+def _causal_block_dispatch(i, j, block_q, block_kv, accumulate):
+    """Run ``accumulate(masked)`` for the causally-relevant (i, j) tile.
+
+    One definition of the two correctness-critical predicates shared by
+    all four packed multi-tile kernels: a block participates iff its first
+    kv position <= the q block's last position, and it needs the (full
+    VPU pass) causal select iff it straddles the diagonal — fully-below
+    blocks (last kv pos <= first q pos) run unmasked. Blocks strictly
+    above the diagonal run neither branch."""
+    straddles = j * block_kv + block_kv - 1 > i * block_q
+
+    @pl.when((j * block_kv <= i * block_q + block_q - 1) & straddles)
+    def _():
+        accumulate(True)
+
+    @pl.when(jnp.logical_not(straddles))
+    def _():
+        accumulate(False)
+
+
 def _packed_scores(qt, kt, sl, scale, mask):
     """fp32 score tile for head slice ``sl`` of packed q/k tiles;
     ``mask=None`` skips the causal select (fully-below-diagonal blocks)."""
@@ -566,19 +586,9 @@ def _fwd_kernel_packed_multi(q_ref, k_ref, v_ref, o_ref, lse_ref,
             )
             m_scr[:, cl] = m_new
 
-    # The causal select is a full VPU pass over the fp32 score tile; only
-    # diagonal-straddling blocks need it. Fully-below-diagonal blocks
-    # (last kv pos <= first q pos) run unmasked — at T/block = 8 that is
-    # 28 of 36 valid blocks.
-    straddles = j * block_kv + block_kv - 1 > i * block_q
-
-    @pl.when((j * block_kv <= i * block_q + block_q - 1) & straddles)
-    def _():
-        _accumulate(True)
-
-    @pl.when(jnp.logical_not(straddles))
-    def _():
-        _accumulate(False)
+    # The causal select is a full VPU pass over the fp32 score tile; at
+    # T/block = 8 the dispatch skips it on 28 of 36 valid blocks.
+    _causal_block_dispatch(i, j, block_q, block_kv, _accumulate)
 
     @pl.when(j == pl.num_programs(3) - 1)
     def _():
@@ -640,16 +650,7 @@ def _bwd_kernel_packed_multi(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
             dk_scr[rows, sl] += dk_c
             dv_scr[rows, sl] += dv_c
 
-    # Mask only where the block straddles the diagonal (see fwd kernel).
-    straddles = j * block_kv + block_kv - 1 > i * block_q
-
-    @pl.when((j * block_kv <= i * block_q + block_q - 1) & straddles)
-    def _():
-        _accumulate(True)
-
-    @pl.when(jnp.logical_not(straddles))
-    def _():
-        _accumulate(False)
+    _causal_block_dispatch(i, j, block_q, block_kv, _accumulate)
 
     @pl.when(j == nkv - 1)
     def _():
@@ -719,15 +720,7 @@ def _dq_kernel_packed(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                 preferred_element_type=jnp.float32,
             ) * scale
 
-    straddles = j * block_kv + block_kv - 1 > i * block_q
-
-    @pl.when((j * block_kv <= i * block_q + block_q - 1) & straddles)
-    def _():
-        _accumulate(True)
-
-    @pl.when(jnp.logical_not(straddles))
-    def _():
-        _accumulate(False)
+    _causal_block_dispatch(i, j, block_q, block_kv, _accumulate)
 
     @pl.when(j == pl.num_programs(3) - 1)
     def _():
@@ -762,15 +755,7 @@ def _dkv_kernel_packed(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                 preferred_element_type=jnp.float32,
             )
 
-    straddles = j * block_kv + block_kv - 1 > i * block_q
-
-    @pl.when((j * block_kv <= i * block_q + block_q - 1) & straddles)
-    def _():
-        _accumulate(True)
-
-    @pl.when(jnp.logical_not(straddles))
-    def _():
-        _accumulate(False)
+    _causal_block_dispatch(i, j, block_q, block_kv, _accumulate)
 
     @pl.when(i == pl.num_programs(3) - 1)
     def _():
@@ -1025,6 +1010,14 @@ def flash_causal_attention(
         )
 
     g = _packed_group(d, h)
+    if (block_q_bwd or block_kv_bwd) and g is None:
+        # The transpose-layout fallback has no independent backward tiling;
+        # silently running the forward tiling there would make sweep-tuned
+        # A/B numbers lie.
+        raise ValueError(
+            "attention_block_{q,kv}_bwd require the packed flash path "
+            f"(128 % head_dim == 0 and heads % group == 0); got D={d}, H={h}"
+        )
     if g is not None:
         # Packed transpose-free path: heads group into 128-lane blocks ->
         # operate on the model-native (B, T, H*D) layout directly. reshape

@@ -93,8 +93,9 @@ def main() -> None:
             print(f"bq={bq:5d} bkv={bkv:5d}  fwd {t_fwd:8.3f} ms ({eff_f:5.1%} peak)"
                   f"  fwd+bwd {t_all:8.3f} ms ({eff_a:5.1%} peak)", flush=True)
         except Exception as e:  # noqa: BLE001 — sweep survives bad tilings
+            first = (str(e).splitlines() or [""])[0]
             print(f"bq={bq:5d} bkv={bkv:5d}  FAILED: {type(e).__name__}: "
-                  f"{str(e).splitlines()[0][:90]}", flush=True)
+                  f"{first[:90]}", flush=True)
 
 
 if __name__ == "__main__":

@@ -45,8 +45,9 @@ def main() -> None:
             )
             print(f"bq={bq:5d} bkv={bkv:5d}  step {ms:8.2f} ms", flush=True)
         except Exception as e:  # noqa: BLE001
+            first = (str(e).splitlines() or [""])[0]
             print(f"bq={bq:5d} bkv={bkv:5d}  FAILED: {type(e).__name__}: "
-                  f"{str(e).splitlines()[0][:90]}", flush=True)
+                  f"{first[:90]}", flush=True)
 
 
 if __name__ == "__main__":
