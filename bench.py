@@ -225,7 +225,7 @@ def main() -> None:
     # kernels' (PERF.md round-5 ceiling analysis).
     long_ctx_hd128 = _safe("long_ctx_hd128", lambda: run_config(
         batch=4, remat="block_save_flash", prng_impl="rbg", max_seq_len=4096,
-        bench_steps=10, n_heads=4,
+        bench_steps=10, n_heads=4, attention_block_kv=1024,
     ))
     # MoE: flagship dims with an E=8 top-2 expert FFN (Switch-style einsum
     # dispatch; MFU uses the MoE-structural FLOP count, metrics.py).

@@ -42,6 +42,14 @@ def test_repo_configs_load():
     assert opt_cfg.lr == pytest.approx(3e-4)
     t3, _, _ = load_config("configs/train_config_3d.yaml")
     assert t3.mesh.pipe == 2
+    # Long-context example: sweep-tuned asymmetric fwd/bwd flash tilings.
+    _, mlc, _ = load_config(
+        "configs/train_config_longctx.yaml",
+        model_config_path="configs/model_config_longctx.yaml",
+    )
+    assert mlc.max_seq_len == 4096 and mlc.attention_block_kv == 1024
+    assert mlc.attention_block_kv_bwd == 512
+    assert mlc.remat_mode == "block_save_flash"
 
 
 def test_model_config_validation():

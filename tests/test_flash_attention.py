@@ -172,6 +172,36 @@ def test_packed_multi_tile_grad_parity():
                                    err_msg=f"d{name}")
 
 
+def test_bwd_tiling_override_is_semantically_invisible():
+    """attention_block_{q,kv}_bwd retile the backward only — gradients
+    must match the default tiling to fp32 accumulation noise, and the
+    knob must refuse the non-packed fallback loudly (it would silently
+    run the forward tiling there)."""
+    t, d, h = 256, 32, 8
+    q, k, v = _qkv(jax.random.PRNGKey(9), 2, t, h, d)
+
+    def loss(bqb, bkvb):
+        return jax.grad(
+            lambda q, k, v: jnp.sum(flash_causal_attention(
+                q, k, v, block_q=64, block_kv=128,
+                block_q_bwd=bqb, block_kv_bwd=bkvb,
+            ) ** 2),
+            argnums=(0, 1, 2),
+        )(q, k, v)
+
+    g_default = loss(0, 0)
+    g_retiled = loss(128, 256)
+    for name, a, b in zip("qkv", g_default, g_retiled):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a), atol=2e-5,
+                                   err_msg=f"d{name}")
+
+    # Non-packed fallback (head_dim 48: 128 % 48 != 0) must reject the knob.
+    q3, k3, v3 = _qkv(jax.random.PRNGKey(10), 1, 256, 2, 48)
+    with pytest.raises(ValueError, match="packed flash path"):
+        flash_causal_attention(q3, k3, v3, block_q=128, block_kv=128,
+                               block_kv_bwd=256)
+
+
 def test_packed_split_bwd_grad_parity(monkeypatch):
     """The long-context backward (T > _PACKED_MAX_T routes to the split
     dq/dkv kernels with O(block) scratch). Shrink the threshold so the
