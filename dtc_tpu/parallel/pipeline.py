@@ -344,6 +344,11 @@ def create_pp_train_step(
 # 1F1B schedule
 # --------------------------------------------------------------------------
 
+#: Hard cap on the 1F1B unrolled tick count — the measured compile-time
+#: knee (scripts/compile_curve_1f1b.py; see create_1f1b_train_step).
+MAX_1F1B_TICKS = 96
+
+
 def simulate_interleaved(m: int, s_count: int, v_count: int = 1):
     """Static (interleaved) 1F1B schedule tables.
 
@@ -537,14 +542,14 @@ def create_1f1b_train_step(
     # GPipe (autodiff through a lax.scan clock, O(1) program size) is the
     # supported schedule for very large M — its bubble *ratio* at large M
     # is the same and its activation memory is the price (docstring).
-    if n_ticks > 96:
+    if n_ticks > MAX_1F1B_TICKS:
         raise ValueError(
             f"1f1b schedule has {n_ticks} ticks (microbatches={m}, "
             f"stages={num_stages}, virtual={v_count}); the unrolled program "
-            "past ~96 ticks takes minutes to compile (measured curve in "
-            "scripts/compile_curve_1f1b.py / PERF.md). Use pp_schedule: "
-            "gpipe for very large microbatch counts, or reduce "
-            "pp_microbatches / pp_virtual_stages."
+            f"past ~{MAX_1F1B_TICKS} ticks takes minutes to compile "
+            "(measured curve in scripts/compile_curve_1f1b.py / PERF.md). "
+            "Use pp_schedule: gpipe for very large microbatch counts, or "
+            "reduce pp_microbatches / pp_virtual_stages."
         )
 
     if v_count == 1:

@@ -36,7 +36,7 @@ def main() -> None:
     from dtc_tpu.config.schema import MeshConfig, ModelConfig, OptimConfig, TrainConfig
     from dtc_tpu.models.gpt import GPT
     from dtc_tpu.parallel.mesh import mesh_from_config
-    from dtc_tpu.parallel.pipeline import simulate_interleaved
+    from dtc_tpu.parallel.pipeline import MAX_1F1B_TICKS, simulate_interleaved
     from dtc_tpu.parallel.sharding import DEFAULT_RULES
     from dtc_tpu.train.train_step import Batch, create_train_step
     from dtc_tpu.train.trainer import init_state
@@ -50,13 +50,21 @@ def main() -> None:
     mesh = mesh_from_config("3d", MeshConfig(pipe=4, data=2, model=1))
 
     for m in args.ms:
+        n_ticks = len(simulate_interleaved(m, 4, args.virtual)[0])
+        if n_ticks > MAX_1F1B_TICKS:
+            # The measured knee from this script's own earlier points now
+            # lives as a hard guard in create_1f1b_train_step; report
+            # instead of tripping it.
+            print(f"M={m:3d} V={args.virtual} ticks={n_ticks:4d}  "
+                  f"capped by create_1f1b_train_step (>{MAX_1F1B_TICKS} "
+                  "ticks; use gpipe)", flush=True)
+            continue
         train_cfg = TrainConfig(
             seed=0, parallel="3d", batch=2 * m, steps=1, log_every=1,
             output_dir="", pp_microbatches=m, pp_schedule="1f1b",
             pp_virtual_stages=args.virtual,
             mesh=MeshConfig(pipe=4, data=2, model=1), dataset="synthetic",
         )
-        n_ticks = len(simulate_interleaved(m, 4, args.virtual)[0])
         model = GPT(model_cfg)
         with mesh, nn.logical_axis_rules(DEFAULT_RULES):
             state = init_state(model, model_cfg, train_cfg, opt_cfg, mesh, DEFAULT_RULES)

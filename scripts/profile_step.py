@@ -20,6 +20,7 @@ sys.path.insert(0, REPO)
 
 def run(batch: int, heads: int, steps: int, trace_dir: str, remat: bool,
         seq: int = 512, block_q: int = 512, block_kv: int = 512,
+        block_q_bwd: int = 0, block_kv_bwd: int = 0,
         moe_experts: int = 0) -> float:
     from bench_common import time_step
 
@@ -28,7 +29,9 @@ def run(batch: int, heads: int, steps: int, trace_dir: str, remat: bool,
     return time_step(
         steps=20, trace_dir=trace_dir, trace_steps=steps,
         batch=batch, heads=heads, remat=remat, max_seq_len=seq,
-        block_q=block_q, block_kv=block_kv, moe_experts=moe_experts,
+        block_q=block_q, block_kv=block_kv,
+        block_q_bwd=block_q_bwd, block_kv_bwd=block_kv_bwd,
+        moe_experts=moe_experts,
     )
 
 
@@ -68,6 +71,8 @@ if __name__ == "__main__":
     ap.add_argument("--seq", type=int, default=512)
     ap.add_argument("--block-q", type=int, default=512)
     ap.add_argument("--block-kv", type=int, default=512)
+    ap.add_argument("--block-q-bwd", type=int, default=0)
+    ap.add_argument("--block-kv-bwd", type=int, default=0)
     ap.add_argument("--heads", type=int, default=16)
     ap.add_argument("--moe-experts", type=int, default=0)
     ap.add_argument("--steps", type=int, default=6)
@@ -82,6 +87,8 @@ if __name__ == "__main__":
     remat = False if args.remat == "none" else args.remat
     step_ms = run(args.batch, args.heads, args.steps, args.trace_dir,
                   remat, seq=args.seq, block_q=args.block_q,
-                  block_kv=args.block_kv, moe_experts=args.moe_experts)
+                  block_kv=args.block_kv, block_q_bwd=args.block_q_bwd,
+                  block_kv_bwd=args.block_kv_bwd,
+                  moe_experts=args.moe_experts)
     print(f"# measured step time: {step_ms:.2f} ms")
     parse(args.trace_dir, args.steps, args.top)
