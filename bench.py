@@ -25,6 +25,11 @@ import time
 
 BASELINE_TOKENS_PER_SEC = 27_900.0  # reference DP/TP, SURVEY.md §6
 
+#: Flagship GPT-89.6M dims shared by every bench config (heads/seq vary
+#: per config; these do not — one definition so decode and train rows
+#: cannot silently drift onto different models).
+FLAGSHIP_DIMS = dict(vocab_size=50258, d_model=512, n_layers=12, d_ff=2048)
+
 
 def run_config(
     batch: int,
@@ -54,7 +59,7 @@ def run_config(
     from dtc_tpu.utils.metrics import mfu
 
     model_cfg = ModelConfig(
-        vocab_size=50258, d_model=512, n_layers=12, n_heads=n_heads, d_ff=2048,
+        **FLAGSHIP_DIMS, n_heads=n_heads,
         max_seq_len=max_seq_len, dropout=0.1, param_dtype="float32",
         compute_dtype="bfloat16", attention="auto", remat=remat,
         moe_experts=moe_experts,
@@ -110,6 +115,50 @@ def run_config(
         "tokens_per_sec": round(tokens_per_sec, 1),
         "mfu": round(u, 4) if u is not None else None,
         "final_loss": round(final_loss, 4),
+    }
+
+
+def decode_bench(batch: int = 8, prompt_len: int = 32, new_tokens: int = 128) -> dict:
+    """KV-cache autoregressive decode throughput on the flagship model —
+    a beyond-reference surface (the reference trains and plots only;
+    SURVEY §1 lists no sampling path). Random params: decode cost is
+    shape-, not value-, dependent."""
+    import time
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from dtc_tpu.config.schema import ModelConfig
+    from dtc_tpu.generate import generate
+    from dtc_tpu.models.gpt import GPT
+
+    model_cfg = ModelConfig(
+        **FLAGSHIP_DIMS, n_heads=16,
+        max_seq_len=512, dropout=0.0, param_dtype="float32",
+        compute_dtype="bfloat16", attention="auto",
+    )
+    model = GPT(model_cfg)
+    x = jnp.ones((batch, 1), jnp.int32)
+    params = model.init({"params": jax.random.PRNGKey(0)}, x, train=False)["params"]
+    prompt = jax.random.randint(
+        jax.random.PRNGKey(1), (batch, prompt_len), 0, model_cfg.vocab_size, jnp.int32
+    )
+    out = generate(model, params, prompt, new_tokens)  # compile
+    np.asarray(out)
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        out = generate(model, params, prompt, new_tokens)
+        np.asarray(out)  # sync by value fetch (tunnel-safe)
+        best = min(best, time.perf_counter() - t0)
+    return {
+        "batch": batch,
+        "prompt_len": prompt_len,
+        "new_tokens": new_tokens,
+        "wall_s": round(best, 4),
+        "tokens_per_sec": round(batch * new_tokens / best, 1),
+        "ms_per_token": round(best / new_tokens * 1e3, 3),
     }
 
 
@@ -251,6 +300,7 @@ def main() -> None:
         "long_context_t8192_b2": long_ctx_8k,
         "long_context_t4096_b4_hd128": long_ctx_hd128,
         "moe_e8_top2_b32": moe,
+        "decode_b8": _safe("decode_b8", decode_bench),
         "ring_block_smoke": _safe("ring_block_smoke", ring_block_smoke),
         "mfu": tuned["mfu"],  # honest per-chip utilization on the REFERENCE shape
         "mfu_hd128": hd128.get("mfu"),  # None if the _safe config errored

@@ -25,6 +25,10 @@ def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--ms", type=int, nargs="+", default=[8, 16, 32, 64])
     ap.add_argument("--virtual", type=int, default=1)
+    ap.add_argument("--pipe", type=int, default=4, choices=[1, 2, 4, 8],
+                    help="pipe axis size, must divide the 8-device mesh "
+                         "(use 2 for --virtual 2: the tiny 4-layer model "
+                         "needs n_layers %% (pipe*virtual) == 0)")
     args = ap.parse_args()
 
     import jax
@@ -47,10 +51,11 @@ def main() -> None:
         compute_dtype="float32", attention="dense",
     )
     opt_cfg = OptimConfig(lr=1e-3, weight_decay=0.1, grad_clip=1.0)
-    mesh = mesh_from_config("3d", MeshConfig(pipe=4, data=2, model=1))
+    pipe = args.pipe
+    mesh = mesh_from_config("3d", MeshConfig(pipe=pipe, data=8 // pipe, model=1))
 
     for m in args.ms:
-        n_ticks = len(simulate_interleaved(m, 4, args.virtual)[0])
+        n_ticks = len(simulate_interleaved(m, pipe, args.virtual)[0])
         if n_ticks > MAX_1F1B_TICKS:
             # The measured knee from this script's own earlier points now
             # lives as a hard guard in create_1f1b_train_step; report
@@ -63,7 +68,7 @@ def main() -> None:
             seed=0, parallel="3d", batch=2 * m, steps=1, log_every=1,
             output_dir="", pp_microbatches=m, pp_schedule="1f1b",
             pp_virtual_stages=args.virtual,
-            mesh=MeshConfig(pipe=4, data=2, model=1), dataset="synthetic",
+            mesh=MeshConfig(pipe=pipe, data=8 // pipe, model=1), dataset="synthetic",
         )
         model = GPT(model_cfg)
         with mesh, nn.logical_axis_rules(DEFAULT_RULES):
