@@ -21,12 +21,19 @@ import numpy as np
 
 GOLDEN_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)), "goldens.json")
 
-# Strategy -> train-config overrides. Mirrors the parity matrix.
+# Strategy -> train-config overrides (+ optional "model" overrides).
+# Mirrors the parity matrix; "moe" pins the Switch routing/aux numerics
+# absolutely — per-strategy parity alone would miss a routing regression
+# that shifts every run identically.
 GOLDEN_RUNS = {
     "dp": dict(),
     "tp": dict(mesh=dict(model=4, data=2)),
     "pp": dict(pp_microbatches=2, mesh=dict(pipe=4, data=2)),
     "3d": dict(pp_microbatches=2, mesh=dict(pipe=2, data=2, model=2)),
+    "moe": dict(
+        mesh=dict(model=4, data=2),
+        model=dict(moe_experts=4, moe_top_k=2),
+    ),
 }
 GOLDEN_STEPS = 8
 
@@ -40,16 +47,18 @@ def _run(strategy: str, overrides: dict):
     # plain script.
     from dtc_tpu.config.schema import ModelConfig, OptimConfig
 
+    kw = dict(overrides)
     model_cfg = ModelConfig(
         vocab_size=97, d_model=64, n_layers=4, n_heads=4, d_ff=128,
         max_seq_len=32, dropout=0.0, param_dtype="float32",
         compute_dtype="float32", attention="dense",
+        **kw.pop("model", {}),
     )
     opt_cfg = OptimConfig(lr=1e-3, weight_decay=0.1, grad_clip=1.0)
-    kw = dict(overrides)
     if "mesh" in kw:
         kw["mesh"] = MeshConfig(**kw["mesh"])
-    cfg = make_train_cfg(strategy, steps=GOLDEN_STEPS, **kw)
+    cfg = make_train_cfg(strategy if strategy != "moe" else "tp",
+                         steps=GOLDEN_STEPS, **kw)
     res = train(cfg, model_cfg, opt_cfg)
     return [round(float(v), 6) for v in res.losses]
 
