@@ -12,7 +12,11 @@ from __future__ import annotations
 
 import os
 
-STRATEGIES = ("dp", "tp", "pp", "3d", "fsdp", "moe", "tpu_dp")
+STRATEGIES = ("dp", "tp", "pp", "3d", "fsdp", "moe", "tpu_dp", "longctx")
+
+#: Flagship-scale single-chip runs: charted in their own panel — comparing
+#: them against the small-scale CPU-mesh strategy runs would mislead.
+FLAGSHIP_RUNS = ("tpu_dp", "longctx")
 
 
 def main(output_root: str = "outputs") -> None:
@@ -30,10 +34,7 @@ def main(output_root: str = "outputs") -> None:
     if not runs:
         raise SystemExit(f"no log.csv found under {output_root}/{{{','.join(STRATEGIES)}}}")
 
-    # tpu_dp runs a different model scale — comparing it against the
-    # small-scale strategy runs in either chart would mislead; it gets its
-    # own loss plot below.
-    small = {s: df for s, df in runs.items() if s != "tpu_dp"}
+    small = {s: df for s, df in runs.items() if s not in FLAGSHIP_RUNS}
 
     if small:
         fig, ax = plt.subplots(figsize=(8, 5))
@@ -56,13 +57,18 @@ def main(output_root: str = "outputs") -> None:
         fig.savefig(os.path.join(output_root, "average_elapsed_time.png"), dpi=150)
         print(f"wrote {output_root}/loss.png and {output_root}/average_elapsed_time.png")
 
-    if "tpu_dp" in runs:
-        df = runs["tpu_dp"]
+    flagship = {s: runs[s] for s in FLAGSHIP_RUNS if s in runs}
+    if flagship:
+        labels = {
+            "tpu_dp": "tpu_dp (flagship, b32 x T=512)",
+            "longctx": "longctx (flagship, b4 x T=4096)",
+        }
         fig, ax = plt.subplots(figsize=(8, 5))
-        ax.plot(df["step"], df["loss"], label="tpu_dp (flagship, 1 chip)", linewidth=0.8)
+        for s, df in flagship.items():
+            ax.plot(df["step"], df["loss"], label=labels.get(s, s), linewidth=0.8)
         ax.set_xlabel("step")
         ax.set_ylabel("loss")
-        ax.set_title("Flagship GPT-89.6M on TPU (dp)")
+        ax.set_title("Flagship GPT-89.6M on TPU (1 chip)")
         ax.legend()
         fig.tight_layout()
         fig.savefig(os.path.join(output_root, "tpu_loss.png"), dpi=150)

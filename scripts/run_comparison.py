@@ -121,23 +121,44 @@ train(train_cfg, model_cfg, opt_cfg)
     subprocess.run([sys.executable, "-c", code], env=env, cwd=REPO, check=True)
 
 
+def run_tpu_longctx() -> None:
+    """The committed ``outputs/longctx`` artifact: flagship at T=4096
+    through ``main.py`` with the long-context configs (8x the reference's
+    context cap; sweep-tuned flash tilings)."""
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    print("=== longctx: flagship T=4096 on the real chip ===", flush=True)
+    subprocess.run(
+        [
+            sys.executable, "main.py",
+            "--train_config_path", "configs/train_config_longctx.yaml",
+            "--model_config_path", "configs/model_config_longctx.yaml",
+            "--dataset", "synthetic",
+        ],
+        env=env, cwd=REPO, check=True,
+    )
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--steps", type=int, default=2000, help="CPU-mesh steps per strategy")
     ap.add_argument("--tpu-steps", type=int, default=5000, help="flagship TPU steps")
-    ap.add_argument("--only", choices=[*STRATEGIES, "tpu", "plot"], default=None)
+    ap.add_argument("--only", choices=[*STRATEGIES, "tpu", "longctx", "plot"], default=None)
     args = ap.parse_args()
 
     if args.only in STRATEGIES:
         run_cpu_strategy(args.only, args.steps)
     elif args.only == "tpu":
         run_tpu_flagship(args.tpu_steps)
+    elif args.only == "longctx":
+        run_tpu_longctx()
     elif args.only == "plot":
         pass
     else:
         for name in STRATEGIES:
             run_cpu_strategy(name, args.steps)
         run_tpu_flagship(args.tpu_steps)
+        run_tpu_longctx()
 
     sys.path.insert(0, REPO)
     import plot
