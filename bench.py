@@ -79,7 +79,7 @@ def run_config(
 
     with mesh, nn.logical_axis_rules(DEFAULT_RULES):
         state = init_state(model, model_cfg, train_cfg, opt_cfg, mesh, DEFAULT_RULES)
-        step_fn = create_train_step(mesh, model=model)
+        step_fn = create_train_step(mesh, model=model, state=state)
         # One fixed device-resident batch: the bench measures the train step,
         # not host tokenization (the trainer's prefetch pipeline covers that).
         tok = next(synthetic_batch_iterator(batch, model_cfg.max_seq_len + 1, model_cfg.vocab_size))
@@ -96,16 +96,34 @@ def run_config(
         # Best-of-3 timed loops: the tunneled chip shows ±10-30% run-to-run
         # latency spikes (observed b8 spread 31-78 ms for the identical
         # program); the minimum of three windows is the sustained-throughput
-        # number, the mean of one window is a coin flip.
+        # number, the mean of one window is a coin flip. Each window also
+        # splits host dispatch from blocked-on-device time (the obs
+        # subsystem's step breakdown, at bench granularity): dispatch is
+        # the async step_fn calls returning, blocked is the window
+        # remainder spent waiting on the final value fetch.
         elapsed = float("inf")
+        dispatch = 0.0
         for _ in range(3):
+            disp = 0.0
             start = time.perf_counter()
             for i in range(bench_steps):
+                t0 = time.perf_counter()
                 state, loss = step_fn(
                     state, Batch(x=x, y=y), jax.random.fold_in(key, warmup_steps + i)
                 )
+                disp += time.perf_counter() - t0
             final_loss = float(np.asarray(loss))
-            elapsed = min(elapsed, time.perf_counter() - start)
+            window = time.perf_counter() - start
+            if window < elapsed:
+                elapsed, dispatch = window, disp
+
+        # Live working set, sampled while state/batch are still resident.
+        # (The allocator's PEAK is process-lifetime-monotone, so a
+        # per-config peak would echo whichever earlier config was largest;
+        # the single process-wide peak is reported once at bench level.)
+        from dtc_tpu.obs.device import max_stat, sample_memory
+
+        in_use = max_stat(sample_memory(), "bytes_in_use")
 
     step_time = elapsed / bench_steps
     tokens_per_sec = batch * model_cfg.max_seq_len / step_time
@@ -115,6 +133,11 @@ def run_config(
         "tokens_per_sec": round(tokens_per_sec, 1),
         "mfu": round(u, 4) if u is not None else None,
         "final_loss": round(final_loss, 4),
+        # Step-time breakdown + device memory (None on backends without
+        # PJRT memory accounting).
+        "dispatch_s": round(dispatch / bench_steps, 6),
+        "blocked_s": round(max(0.0, elapsed - dispatch) / bench_steps, 6),
+        "hbm_bytes_in_use": in_use,
     }
 
 
@@ -246,42 +269,58 @@ def _safe(label: str, fn, retries: int = 1):
 def main() -> None:
     import jax
 
-    ref = run_config(batch=8, remat=False, prng_impl="rbg")
-    tuned = run_config(batch=32, remat="block_save_flash", prng_impl="rbg")
+    from dtc_tpu.obs import MemorySink, MetricsRegistry
+
+    # Every per-config result flows through the metrics registry — the
+    # same funnel the trainer emits through — so the BENCH json is a view
+    # over registry events, not a hand-assembled dict.
+    reg = MetricsRegistry()
+    sink = reg.add_sink(MemorySink())
+
+    def emit(label: str, res: dict) -> dict:
+        reg.emit("bench_config", label=label, **res)
+        return res
+
+    ref = emit("reference_workload_b8", run_config(batch=8, remat=False, prng_impl="rbg"))
+    tuned = emit(
+        "tuned_b32_remat",
+        run_config(batch=32, remat="block_save_flash", prng_impl="rbg"),
+    )
     # Same 89.6M-class budget with an MXU-friendly attention shape
     # (head_dim=128): demonstrates the framework, not the workload, sets the
     # ceiling (PERF.md "Why 40% is out of reach for THIS model shape").
-    hd128 = _safe("hd128", lambda: run_config(
-        batch=32, remat="block_save_flash", prng_impl="rbg", n_heads=4))
+    hd128 = emit("mxu_hd128_b32_remat", _safe("hd128", lambda: run_config(
+        batch=32, remat="block_save_flash", prng_impl="rbg", n_heads=4)))
     # Long-context: 8x the flagship sequence through the flash kernel.
     # Tiling from the round-5 on-chip sweep (PERF.md): the forward wants
     # wide KV blocks, the fused backward a square 512 tile.
-    long_ctx = _safe("long_ctx", lambda: run_config(
+    long_ctx = emit("long_context_t4096_b4", _safe("long_ctx", lambda: run_config(
         batch=4, remat="block_save_flash", prng_impl="rbg", max_seq_len=4096,
         bench_steps=10, attention_block_kv=1024,
         attention_block_q_bwd=512, attention_block_kv_bwd=512,
-    ))
+    )))
     # T=8192: exercises the packed SPLIT backward (fused dk/dv scratches
     # exceed VMEM past T=4096) — the shape that had no packed path before
     # round 5.
-    long_ctx_8k = _safe("long_ctx_8k", lambda: run_config(
+    long_ctx_8k = emit("long_context_t8192_b2", _safe("long_ctx_8k", lambda: run_config(
         batch=2, remat="block_save_flash", prng_impl="rbg", max_seq_len=8192,
         bench_steps=8, attention_block_kv=1024,
         attention_block_q_bwd=512, attention_block_kv_bwd=1024,
-    ))
+    )))
     # Same long-context budget at an MXU-friendly head shape (head_dim=128):
     # the hd32 row's gap to peak is the workload's lane bound, not the
     # kernels' (PERF.md round-5 ceiling analysis).
-    long_ctx_hd128 = _safe("long_ctx_hd128", lambda: run_config(
-        batch=4, remat="block_save_flash", prng_impl="rbg", max_seq_len=4096,
-        bench_steps=10, n_heads=4, attention_block_kv=1024,
-    ))
+    long_ctx_hd128 = emit(
+        "long_context_t4096_b4_hd128", _safe("long_ctx_hd128", lambda: run_config(
+            batch=4, remat="block_save_flash", prng_impl="rbg", max_seq_len=4096,
+            bench_steps=10, n_heads=4, attention_block_kv=1024,
+        )))
     # MoE: flagship dims with an E=8 top-2 expert FFN (Switch-style einsum
     # dispatch; MFU uses the MoE-structural FLOP count, metrics.py).
-    moe = _safe("moe", lambda: run_config(
+    moe = emit("moe_e8_top2_b32", _safe("moe", lambda: run_config(
         batch=32, remat="block_save_flash", prng_impl="rbg", moe_experts=8,
         bench_steps=15,
-    ))
+    )))
 
     result = {
         "metric": "tokens_per_sec",
@@ -290,22 +329,30 @@ def main() -> None:
         "vs_baseline": round(ref["tokens_per_sec"] / BASELINE_TOKENS_PER_SEC, 3),
     }
     print(json.dumps(result))
+    emit("decode_b8", _safe("decode_b8", decode_bench))
+    emit("ring_block_smoke", _safe("ring_block_smoke", ring_block_smoke))
+
+    # Assemble the detail line FROM the registry's event stream: each
+    # bench_config event becomes one keyed entry, existing keys unchanged
+    # (new per-config fields ride along: dispatch_s/blocked_s/peak_hbm_bytes).
     extra = {
         "devices": jax.device_count(),
         "device_kind": jax.devices()[0].device_kind,
-        "reference_workload_b8": ref,
-        "tuned_b32_remat": tuned,
-        "mxu_hd128_b32_remat": hd128,
-        "long_context_t4096_b4": long_ctx,
-        "long_context_t8192_b2": long_ctx_8k,
-        "long_context_t4096_b4_hd128": long_ctx_hd128,
-        "moe_e8_top2_b32": moe,
-        "decode_b8": _safe("decode_b8", decode_bench),
-        "ring_block_smoke": _safe("ring_block_smoke", ring_block_smoke),
-        "mfu": tuned["mfu"],  # honest per-chip utilization on the REFERENCE shape
-        "mfu_hd128": hd128.get("mfu"),  # None if the _safe config errored
     }
+    for ev in sink.events:
+        if ev["etype"] != "bench_config":
+            continue
+        body = {k: v for k, v in ev.items() if k not in ("etype", "ts", "proc", "label")}
+        extra[ev["label"]] = body
+    extra["mfu"] = tuned["mfu"]  # honest per-chip utilization on the REFERENCE shape
+    extra["mfu_hd128"] = hd128.get("mfu")  # None if the _safe config errored
+    # Process-lifetime HBM peak (across ALL configs — per-config peaks are
+    # not separable; per-config live working sets are hbm_bytes_in_use).
+    from dtc_tpu.obs import peak_hbm_bytes, sample_memory
+
+    extra["peak_hbm_bytes"] = peak_hbm_bytes(sample_memory())
     print("# bench-detail:", json.dumps(extra))
+    reg.close()
 
 
 if __name__ == "__main__":

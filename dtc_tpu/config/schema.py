@@ -153,6 +153,39 @@ class MeshConfig:
 
 
 @dataclass(frozen=True)
+class ObsConfig:
+    """Telemetry subsystem knobs (``dtc_tpu/obs/``).
+
+    The JSONL event stream lands in ``<output_dir>/obs/events.r<k>.jsonl``
+    (one shard per process) plus a ``summary.json`` written by process 0;
+    the legacy ``log.csv`` / ``eval_log.csv`` files are unaffected by any
+    of these knobs. See README "Observability" for the event schema.
+    """
+
+    enabled: bool = True
+    jsonl: bool = True           # write the per-process JSONL event shard
+    dir: str = ""                # default: <output_dir>/obs
+    # Sample per-device memory_stats() every N steps (0 = off). Host-side
+    # PJRT accounting only — never syncs the device.
+    memory_sample_every: int = 50
+    # Flag a host as a straggler when its mean step time exceeds the
+    # cross-host median by this factor (multi-host runs only).
+    straggler_threshold: float = 1.5
+    # Profiler trace window [start, stop); when left 0/0 the legacy
+    # top-level TrainConfig.profile_start/profile_stop are used.
+    profile_start: int = 0
+    profile_stop: int = 0
+
+    def __post_init__(self) -> None:
+        if self.memory_sample_every < 0:
+            raise ValueError("memory_sample_every must be >= 0")
+        if self.straggler_threshold < 1.0:
+            raise ValueError(
+                f"straggler_threshold must be >= 1.0, got {self.straggler_threshold}"
+            )
+
+
+@dataclass(frozen=True)
 class TrainConfig:
     """Training-run configuration.
 
@@ -208,6 +241,9 @@ class TrainConfig:
     overwrite: bool = False
     profile_start: int = 0       # capture jax.profiler trace [start, stop)
     profile_stop: int = 0
+    # Telemetry subsystem (JSONL events, step breakdown, memory sampling,
+    # multi-host reduction) — see ObsConfig above.
+    obs: ObsConfig = field(default_factory=ObsConfig)
     multihost: bool = False      # call jax.distributed.initialize()
     prng_impl: str = "threefry2x32"  # dropout PRNG; "rbg" is ~4% faster on TPU
     # Dev-config NaN sanitizer (SURVEY §5): enables jax_debug_nans for the

@@ -1,0 +1,39 @@
+"""Unified telemetry subsystem.
+
+Structured metrics (counters/gauges/timers/histograms), a JSONL event
+stream with a back-compat CSV bridge, step-time breakdown with compile /
+recompile tracking, device HBM sampling, a hardened profiler window, and
+multi-host shard reduction with straggler detection. See the README's
+"Observability" section for the event schema and config knobs.
+"""
+
+from dtc_tpu.obs.aggregate import find_shards, reduce_shards, shard_path
+from dtc_tpu.obs.device import max_stat, peak_hbm_bytes, sample_memory
+from dtc_tpu.obs.profiling import StepWindowProfiler
+from dtc_tpu.obs.registry import (
+    CsvSink,
+    JsonlSink,
+    MemorySink,
+    MetricsRegistry,
+    read_jsonl,
+)
+from dtc_tpu.obs.stepclock import CompileWatcher, StepClock
+from dtc_tpu.obs.telemetry import Telemetry
+
+__all__ = [
+    "CompileWatcher",
+    "CsvSink",
+    "JsonlSink",
+    "MemorySink",
+    "MetricsRegistry",
+    "StepClock",
+    "StepWindowProfiler",
+    "Telemetry",
+    "find_shards",
+    "max_stat",
+    "peak_hbm_bytes",
+    "read_jsonl",
+    "reduce_shards",
+    "sample_memory",
+    "shard_path",
+]

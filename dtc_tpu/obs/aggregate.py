@@ -1,0 +1,111 @@
+"""Multi-host telemetry reduction.
+
+Each process writes its own JSONL shard (``events.r<k>.jsonl``); on a pod
+with a shared output filesystem, process 0 reduces them post-run into one
+cross-host view: per-host mean step time, min/max/mean across hosts, and
+a **straggler flag** for any host whose mean step time exceeds the
+cross-host median by a configurable factor — the "one slow host gates the
+whole pod" failure MegaScale-style fleet telemetry exists to catch.
+
+Degrades gracefully: with one shard (single process, or per-host local
+disks) the reduction is a trivial self-summary, never an error.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import re
+import statistics
+from typing import Any
+
+from dtc_tpu.obs.registry import read_jsonl
+
+_SHARD_RE = re.compile(r"events\.r(\d+)\.jsonl$")
+
+
+def shard_path(obs_dir: str, process_index: int) -> str:
+    return os.path.join(obs_dir, f"events.r{process_index}.jsonl")
+
+
+def find_shards(obs_dir: str) -> dict[int, str]:
+    """Process index -> shard path for every shard visible in ``obs_dir``."""
+    shards = {}
+    for p in glob.glob(os.path.join(obs_dir, "events.r*.jsonl")):
+        m = _SHARD_RE.search(p)
+        if m:
+            shards[int(m.group(1))] = p
+    return shards
+
+
+def _step_times(events: list[dict[str, Any]]) -> dict[int, float]:
+    return {
+        e["step"]: e["step_time_s"]
+        for e in events
+        if e.get("etype") == "step"
+        and isinstance(e.get("step"), int)
+        and isinstance(e.get("step_time_s"), (int, float))
+    }
+
+
+def reduce_shards(
+    obs_dir: str, straggler_threshold: float = 1.5
+) -> dict[str, Any] | None:
+    """Cross-host reduction of every shard under ``obs_dir``.
+
+    Returns ``None`` when no shard holds step events (e.g. a run that
+    died before its first step). Otherwise::
+
+        {
+          "hosts": {proc: {"steps": N, "mean_step_time_s": ..,
+                           "min_step_time_s": .., "max_step_time_s": ..,
+                           "straggler": bool}},
+          "step_time_s": {"mean": .., "min": .., "max": ..},  # across hosts
+          "stragglers": [proc, ...],
+          "straggler_threshold": ..,
+          "n_hosts": N,
+        }
+    """
+    shards = find_shards(obs_dir)
+    per_host: dict[int, dict[int, float]] = {}
+    for proc, path in sorted(shards.items()):
+        times = _step_times(read_jsonl(path))
+        if times:
+            per_host[proc] = times
+    if not per_host:
+        return None
+
+    host_means = {
+        proc: sum(t.values()) / len(t) for proc, t in per_host.items()
+    }
+    median = statistics.median(host_means.values())
+    hosts: dict[str, Any] = {}
+    stragglers: list[int] = []
+    for proc, times in per_host.items():
+        mean = host_means[proc]
+        # A host is a straggler when its mean step time exceeds the
+        # cross-host median by the threshold factor. With <2 hosts there
+        # is no peer to lag behind, so the flag stays False.
+        lagging = len(per_host) > 1 and median > 0 and mean > straggler_threshold * median
+        if lagging:
+            stragglers.append(proc)
+        hosts[str(proc)] = {
+            "steps": len(times),
+            "mean_step_time_s": round(mean, 6),
+            "min_step_time_s": round(min(times.values()), 6),
+            "max_step_time_s": round(max(times.values()), 6),
+            "straggler": lagging,
+        }
+    means = list(host_means.values())
+    return {
+        "hosts": hosts,
+        "step_time_s": {
+            "mean": round(sum(means) / len(means), 6),
+            "min": round(min(means), 6),
+            "max": round(max(means), 6),
+            "median": round(median, 6),
+        },
+        "stragglers": sorted(stragglers),
+        "straggler_threshold": straggler_threshold,
+        "n_hosts": len(per_host),
+    }

@@ -1,0 +1,60 @@
+"""Device telemetry: HBM occupancy sampling.
+
+``device.memory_stats()`` is the backend's own accounting (PJRT): on TPU
+it reports ``bytes_in_use`` / ``peak_bytes_in_use`` against real HBM; on
+the CPU backend it returns ``None``. Sampling is a pure host call — no
+device sync, no step perturbation — so the trainer can poll it on a
+cadence without skewing the comparison it is instrumenting.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+
+#: memory_stats keys worth carrying into events, when the backend has them.
+_KEYS = ("bytes_in_use", "peak_bytes_in_use", "bytes_limit", "largest_alloc_size")
+
+
+def sample_memory(local_only: bool = True) -> list[dict[str, Any]] | None:
+    """Per-device memory stats for this process's devices.
+
+    Returns ``None`` when the backend exposes no accounting (CPU) — the
+    JSON stream then carries an explicit null, distinguishing "backend
+    can't say" from "zero bytes".
+    """
+    devices = jax.local_devices() if local_only else jax.devices()
+    out = []
+    any_stats = False
+    for d in devices:
+        try:
+            stats = d.memory_stats()
+        except Exception:  # backends without the PJRT API raise, not return None
+            stats = None
+        if stats is None:
+            out.append({"device": d.id, "stats": None})
+            continue
+        any_stats = True
+        out.append(
+            {"device": d.id, "stats": {k: stats.get(k) for k in _KEYS if k in stats}}
+        )
+    return out if any_stats else None
+
+
+def max_stat(samples: list[dict[str, Any]] | None, key: str) -> int | None:
+    """Max of one memory_stats ``key`` across a ``sample_memory()`` result,
+    or ``None`` when the backend reported nothing."""
+    if not samples:
+        return None
+    vals = [
+        s["stats"][key]
+        for s in samples
+        if s.get("stats") and s["stats"].get(key) is not None
+    ]
+    return max(vals) if vals else None
+
+
+def peak_hbm_bytes(samples: list[dict[str, Any]] | None) -> int | None:
+    """Max ``peak_bytes_in_use`` across one ``sample_memory()`` result."""
+    return max_stat(samples, "peak_bytes_in_use")

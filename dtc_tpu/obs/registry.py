@@ -1,0 +1,270 @@
+"""Metrics registry: typed instruments + event sinks.
+
+The repo's original instruments were a 3-column CSV writer and an inline
+MFU print buried in the trainer. This registry is the one funnel every
+runtime (trainer, bench, future pipeline/generate drivers) emits through:
+
+- **instruments** — named counters, gauges, timers, and histograms whose
+  current values land in the run summary (``snapshot()``);
+- **events** — structured records (``emit(etype, **fields)``) fanned out
+  to sinks: a JSONL shard per process (the telemetry stream the
+  multi-host reducer consumes, see :mod:`dtc_tpu.obs.aggregate`) and a
+  back-compat CSV sink that keeps ``log.csv`` byte-compatible with the
+  reference schema so ``plot.py`` and the committed ``outputs/``
+  artifacts keep working.
+
+Everything here is host-side pure Python — no JAX imports — so it can be
+unit-tested without a backend and never adds device work to the step.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import time
+from typing import IO, Any, Callable
+
+from dtc_tpu.utils.logging import CSVLogger
+
+
+class Counter:
+    """Monotonic count (events seen, batches fed, recompiles)."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self.value = 0
+
+    def inc(self, n: int = 1) -> None:
+        self.value += n
+
+
+class Gauge:
+    """Last-written value (tokens/s, peak HBM). ``None`` = never set /
+    unknown — serialized as JSON null, matching the MFU convention."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self.value: float | None = None
+
+    def set(self, v: float | None) -> None:
+        self.value = v if v is None else float(v)
+
+
+class Histogram:
+    """Streaming summary (count/sum/min/max + mean) — enough for step-time
+    spread without holding per-step samples for a 5000-step run."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self.count = 0
+        self.total = 0.0
+        self.min: float | None = None
+        self.max: float | None = None
+
+    def observe(self, v: float) -> None:
+        v = float(v)
+        if not math.isfinite(v):
+            return
+        self.count += 1
+        self.total += v
+        self.min = v if self.min is None else min(self.min, v)
+        self.max = v if self.max is None else max(self.max, v)
+
+    @property
+    def mean(self) -> float | None:
+        return self.total / self.count if self.count else None
+
+    def summary(self) -> dict[str, float | int | None]:
+        return {
+            "count": self.count,
+            "mean": self.mean,
+            "min": self.min,
+            "max": self.max,
+            "total": self.total,
+        }
+
+
+class Timer:
+    """A histogram observed via context manager — wall-clock phases."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self.hist = Histogram(name)
+        self.last: float | None = None
+
+    def __enter__(self) -> "Timer":
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.last = time.perf_counter() - self._t0
+        self.hist.observe(self.last)
+
+
+# --------------------------------------------------------------------------
+# sinks
+
+
+class JsonlSink:
+    """One JSON object per line, one file per process.
+
+    The shard name encodes the process index (``events.r<k>.jsonl``) so the
+    process-0 reducer can discover sibling shards on a shared filesystem
+    and still degrade to single-shard mode when there is only its own.
+    """
+
+    def __init__(self, path: str, append: bool = False):
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self.path = path
+        # append=True on resumed runs: truncating would wipe the preempted
+        # run's events — the prefix the crash-survival contract preserved.
+        self._fh: IO | None = open(path, "a" if append else "w")
+
+    def write(self, event: dict[str, Any]) -> None:
+        if self._fh is None:
+            return
+        self._fh.write(json.dumps(event, sort_keys=False) + "\n")
+
+    def flush(self) -> None:
+        if self._fh:
+            self._fh.flush()
+
+    def close(self) -> None:
+        if self._fh:
+            self._fh.close()
+            self._fh = None
+
+
+class CsvSink:
+    """Back-compat bridge: events of one type become CSV rows.
+
+    Keeps the reference's ``log.csv`` schema (``step, elapsed_time, loss``)
+    alive while everything else moves to structured events — ``plot.py``,
+    ``tests/test_artifacts.py``, and the reference's own tooling read this
+    file unchanged.
+    """
+
+    def __init__(self, path: str, fieldnames: tuple[str, ...], etype: str):
+        self.etype = etype
+        self._fieldnames = fieldnames
+        self._csv = CSVLogger(path, fieldnames=fieldnames)
+
+    def write(self, event: dict[str, Any]) -> None:
+        if event.get("etype") != self.etype:
+            return
+        self._csv.log(**{k: event[k] for k in self._fieldnames if k in event})
+
+    def flush(self) -> None:
+        self._csv.flush()
+
+    def close(self) -> None:
+        self._csv.close()
+
+
+class MemorySink:
+    """Collect events in a list — bench.py and tests read results back
+    without touching the filesystem."""
+
+    def __init__(self):
+        self.events: list[dict[str, Any]] = []
+
+    def write(self, event: dict[str, Any]) -> None:
+        self.events.append(event)
+
+    def flush(self) -> None:
+        pass
+
+    def close(self) -> None:
+        pass
+
+
+# --------------------------------------------------------------------------
+# registry
+
+
+class MetricsRegistry:
+    """Instrument factory + event bus.
+
+    ``emit`` stamps each event with its type, a wall-clock timestamp, and
+    the emitting process index, then fans it out to every sink. Instrument
+    getters are idempotent: ``counter("recompiles")`` returns the same
+    object every call, so call sites never coordinate.
+    """
+
+    def __init__(self, process_index: int = 0):
+        self.process_index = process_index
+        self._counters: dict[str, Counter] = {}
+        self._gauges: dict[str, Gauge] = {}
+        self._timers: dict[str, Timer] = {}
+        self._hists: dict[str, Histogram] = {}
+        self._sinks: list[Any] = []
+        self._clock: Callable[[], float] = time.time
+
+    def add_sink(self, sink: Any) -> Any:
+        self._sinks.append(sink)
+        return sink
+
+    # -- instruments ------------------------------------------------------
+    def counter(self, name: str) -> Counter:
+        return self._counters.setdefault(name, Counter(name))
+
+    def gauge(self, name: str) -> Gauge:
+        return self._gauges.setdefault(name, Gauge(name))
+
+    def timer(self, name: str) -> Timer:
+        return self._timers.setdefault(name, Timer(name))
+
+    def histogram(self, name: str) -> Histogram:
+        return self._hists.setdefault(name, Histogram(name))
+
+    # -- events -----------------------------------------------------------
+    def emit(self, etype: str, **fields: Any) -> dict[str, Any]:
+        event: dict[str, Any] = {
+            "etype": etype,
+            "ts": self._clock(),
+            "proc": self.process_index,
+        }
+        event.update(fields)
+        for sink in self._sinks:
+            sink.write(event)
+        return event
+
+    def snapshot(self) -> dict[str, Any]:
+        """Current instrument values, JSON-ready — the run summary body."""
+        out: dict[str, Any] = {}
+        for n, c in self._counters.items():
+            out[n] = c.value
+        for n, g in self._gauges.items():
+            out[n] = g.value
+        for n, h in self._hists.items():
+            out[n] = h.summary()
+        for n, t in self._timers.items():
+            out[n] = t.hist.summary()
+        return out
+
+    def flush(self) -> None:
+        for sink in self._sinks:
+            sink.flush()
+
+    def close(self) -> None:
+        for sink in self._sinks:
+            sink.close()
+        self._sinks = []
+
+
+def read_jsonl(path: str) -> list[dict[str, Any]]:
+    """Parse a JSONL shard, skipping any torn final line (a crashed or
+    still-running writer leaves one; the stream's whole point is surviving
+    that)."""
+    events = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                events.append(json.loads(line))
+            except json.JSONDecodeError:
+                continue
+    return events

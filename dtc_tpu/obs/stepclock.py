@@ -1,0 +1,135 @@
+"""Step-time breakdown and compile tracking.
+
+Answers the question the paper's comparison hangs on but the seed repo
+could not: *where does a step's wall-clock go?* Four host-side phases are
+timed around the existing train step (no device instrumentation, no step
+overhead beyond four ``perf_counter`` calls):
+
+- ``data_wait_s``   — blocked on ``next(data_it)``: host tokenization /
+                      packing that prefetch failed to hide, plus the
+                      host->device transfer for synchronous feeding;
+- ``dispatch_s``    — the ``train_step`` call itself returning: trace /
+                      lowering / executable launch (async dispatch means
+                      this is ~0 in steady state; a spike = recompile);
+- ``block_s``       — blocked on the device finishing (only when the
+                      trainer syncs per step, else 0.0);
+- ``step_time_s``   — whole-step wall-clock, begin->end.
+
+Compile time comes from ``jax.monitoring``'s
+``/jax/core/compile/backend_compile_duration`` stream — the actual XLA
+backend-compile seconds, not a timing heuristic. The first observation
+window is the run's compile cost; any later one is a **recompile** (a
+shape or donation mismatch silently eating a step) and is flagged.
+"""
+
+from __future__ import annotations
+
+import time
+
+_BACKEND_COMPILE = "/jax/core/compile/backend_compile_duration"
+
+# One process-wide listener, registered lazily on first CompileWatcher
+# activation: jax.monitoring has no per-listener deregistration, so the
+# listener is permanent and routes to whichever watcher is active (or
+# drops the event when none is).
+_active_watcher: "CompileWatcher | None" = None
+_listener_registered = False
+
+
+def _on_event_duration(name: str, duration: float, **kw) -> None:
+    w = _active_watcher
+    if w is not None and name == _BACKEND_COMPILE:
+        w._seconds += duration
+        w._count += 1
+
+
+def _ensure_listener() -> None:
+    global _listener_registered
+    if _listener_registered:
+        return
+    import jax.monitoring
+
+    jax.monitoring.register_event_duration_secs_listener(_on_event_duration)
+    _listener_registered = True
+
+
+class CompileWatcher:
+    """Accumulates XLA backend-compile seconds while active.
+
+    ``drain()`` returns and resets the window — callers attribute the
+    drained seconds to whatever phase just ran (init, warmup, step N).
+    """
+
+    def __init__(self):
+        self._seconds = 0.0
+        self._count = 0
+
+    def activate(self) -> "CompileWatcher":
+        global _active_watcher
+        _ensure_listener()
+        _active_watcher = self
+        return self
+
+    def deactivate(self) -> None:
+        global _active_watcher
+        if _active_watcher is self:
+            _active_watcher = None
+
+    def drain(self) -> tuple[float, int]:
+        s, c = self._seconds, self._count
+        self._seconds, self._count = 0.0, 0
+        return s, c
+
+
+class StepClock:
+    """Phase timer for one training step.
+
+    Usage in the trainer loop::
+
+        clock.begin(step)
+        with clock.phase("data_wait"): x, y = next(data_it)
+        with clock.phase("dispatch"):  state, loss = train_step(...)
+        with clock.phase("block"):     jax.block_until_ready(loss)
+        breakdown = clock.end()        # dict of *_s floats
+    """
+
+    PHASES = ("data_wait", "dispatch", "block")
+
+    def __init__(self):
+        self._t0: float | None = None
+        self._acc: dict[str, float] = {}
+        self.step: int | None = None
+
+    def begin(self, step: int) -> None:
+        self.step = step
+        self._acc = {p: 0.0 for p in self.PHASES}
+        self._t0 = time.perf_counter()
+
+    def phase(self, name: str) -> "_Phase":
+        return _Phase(self._acc, name)
+
+    def end(self) -> dict[str, float]:
+        total = time.perf_counter() - (self._t0 or time.perf_counter())
+        out = {f"{p}_s": round(v, 6) for p, v in self._acc.items()}
+        out["step_time_s"] = round(total, 6)
+        # Whatever the three phases don't cover is host-side loop overhead
+        # (logging, checkpoint bookkeeping) — worth seeing when it grows.
+        out["other_s"] = round(max(0.0, total - sum(self._acc.values())), 6)
+        return out
+
+
+class _Phase:
+    __slots__ = ("_acc", "_name", "_t0")
+
+    def __init__(self, acc: dict[str, float], name: str):
+        self._acc = acc
+        self._name = name
+
+    def __enter__(self) -> "_Phase":
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self._acc[self._name] = self._acc.get(self._name, 0.0) + (
+            time.perf_counter() - self._t0
+        )

@@ -1,0 +1,291 @@
+"""Telemetry facade: the hook surface runtimes emit through.
+
+One object owns the registry, sinks, step clock, compile watcher, memory
+sampler, and profiler; the trainer (and any future runtime — pipeline,
+generate) talks to it through a small hook interface::
+
+    tele.on_run_start(...)
+    tele.on_step_start(step)
+    with tele.clock.phase("data_wait"): ...
+    tele.on_step_end(step, synced=...)
+    tele.on_eval(step, loss, duration_s)
+    tele.on_run_end(...); tele.close()
+
+so new runtimes get the full event stream by registering hooks instead of
+threading CSV loggers and profilers through their loops.
+
+Event stream schema (JSONL, one shard per process — see README
+"Observability"):
+
+- ``run_start``    — config fingerprint: strategy, mesh, batch, devices;
+- ``compile``      — first XLA backend-compile window (init + warmup),
+                     labeled step 0;
+- ``recompile``    — any later compile: something changed shape mid-run;
+- ``step``         — per-step breakdown: ``data_wait_s``, ``dispatch_s``,
+                     ``block_s``, ``other_s``, ``step_time_s``,
+                     cumulative ``elapsed_s``;
+- ``train_row``    — the CSV-schema row (step, elapsed_time, loss), also
+                     bridged to ``log.csv`` by the CSV sink;
+- ``window``       — log-boundary throughput: avg step time, tokens/s, MFU;
+- ``eval``         — held-out eval loss (bridged to ``eval_log.csv``);
+- ``memory``       — per-device HBM sample (``null`` stats on CPU);
+- ``hosts``        — cross-host reduction + straggler flags (lead only);
+- ``run_summary``  — totals: tokens/s, MFU, peak HBM, compile/recompile
+                     counts, est. comm bytes per step.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any
+
+from dtc_tpu.obs.aggregate import reduce_shards, shard_path
+from dtc_tpu.obs.device import peak_hbm_bytes, sample_memory
+from dtc_tpu.obs.profiling import StepWindowProfiler
+from dtc_tpu.obs.registry import CsvSink, JsonlSink, MetricsRegistry
+from dtc_tpu.obs.stepclock import CompileWatcher, StepClock
+
+
+class Telemetry:
+    def __init__(
+        self,
+        obs_cfg: Any = None,
+        *,
+        output_dir: str = "",
+        lead: bool = True,
+        process_index: int = 0,
+        profiler: StepWindowProfiler | None = None,
+        append: bool = False,
+    ):
+        from dtc_tpu.config.schema import ObsConfig
+
+        self.cfg = obs_cfg if obs_cfg is not None else ObsConfig()
+        self.output_dir = output_dir
+        self.lead = lead
+        self.registry = MetricsRegistry(process_index=process_index)
+        self.clock = StepClock()
+        self.compiles = CompileWatcher()
+        self.profiler = profiler or StepWindowProfiler(0, 0, "")
+        self.obs_dir = ""
+        # False until the first timed step completes: compile seconds
+        # observed before then are startup cost (init, warmup, the first
+        # step's own trace), never flagged as recompiles.
+        self._steady = False
+        self._jsonl: JsonlSink | None = None
+        self._closed = False
+        if self.cfg.enabled and self.cfg.jsonl and output_dir:
+            self.obs_dir = self.cfg.dir or os.path.join(output_dir, "obs")
+            try:
+                self._jsonl = self.registry.add_sink(
+                    JsonlSink(shard_path(self.obs_dir, process_index), append=append)
+                )
+            except OSError as e:  # unwritable dir: observe-or-ignore, never crash
+                print(f"[dtc_tpu] WARNING: telemetry JSONL disabled ({e})")
+                self.obs_dir = ""
+        self.compiles.activate()
+
+    # -- construction -----------------------------------------------------
+    @classmethod
+    def for_training(
+        cls, train_cfg, *, lead: bool, process_index: int, resumed: bool = False
+    ) -> "Telemetry":
+        """Build the trainer's telemetry from its config block.
+
+        The profiler window comes from ``ObsConfig`` when set there,
+        falling back to the legacy top-level ``profile_start/profile_stop``
+        fields so existing configs keep capturing traces. ``resumed`` runs
+        APPEND to the existing JSONL shard — truncating would destroy the
+        preempted run's events, the prefix crash-survival just preserved.
+        (The CSV bridges intentionally keep the legacy rewrite-from-
+        restored-step semantics documented in config.schema: log.csv is a
+        derived artifact; the JSONL stream is the durable history.)
+        """
+        obs = train_cfg.obs
+        start, stop = obs.profile_start, obs.profile_stop
+        if stop <= start:
+            start, stop = train_cfg.profile_start, train_cfg.profile_stop
+        profiler = StepWindowProfiler(
+            start, stop, os.path.join(train_cfg.output_dir, "profile")
+        )
+        return cls(
+            obs,
+            output_dir=train_cfg.output_dir,
+            lead=lead,
+            process_index=process_index,
+            profiler=profiler,
+            append=resumed,
+        )
+
+    def add_csv(self, path: str, fieldnames: tuple[str, ...], etype: str) -> CsvSink:
+        """Attach a back-compat CSV bridge (log.csv / eval_log.csv). CSV
+        output is NOT gated on ``obs.enabled`` — it predates the subsystem
+        and the committed artifacts depend on it."""
+        return self.registry.add_sink(CsvSink(path, fieldnames, etype))
+
+    # -- hooks ------------------------------------------------------------
+    def on_run_start(self, **meta: Any) -> None:
+        self.registry.emit("run_start", **meta)
+
+    def on_step_start(self, step: int) -> None:
+        self.profiler.step(step)
+        self.clock.begin(step)
+
+    def on_step_end(self, step: int, *, elapsed_s: float, synced: bool) -> dict:
+        """Close the step's clock, fold in any compile the step triggered,
+        emit the ``step`` event, and sample memory on cadence."""
+        breakdown = self.clock.end()
+        self.registry.histogram("step_time_s").observe(breakdown["step_time_s"])
+        self.registry.histogram("data_wait_s").observe(breakdown["data_wait_s"])
+        compile_s, n = self.compiles.drain()
+        extra: dict[str, Any] = {}
+        if n:
+            extra["compile_s"] = round(compile_s, 4)
+            if self._steady:
+                # Same executable should serve every step — a mid-run
+                # compile means a shape/dtype/donation change slipped in.
+                self.registry.counter("recompiles").inc(n)
+                extra["recompile"] = True
+                self.registry.emit(
+                    "recompile", step=step, compile_s=round(compile_s, 4), count=n
+                )
+            else:
+                # First timed step: with warmup_steps=0 the train step's
+                # cold compile lands HERE, not in record_startup_compile —
+                # still startup cost, never a recompile.
+                self._note_startup_compile(compile_s, n)
+        self._steady = True
+        self.registry.emit(
+            "step",
+            step=step,
+            elapsed_s=round(elapsed_s, 6),
+            synced=synced,
+            **breakdown,
+            **extra,
+        )
+        every = self.cfg.memory_sample_every
+        if self.cfg.enabled and every > 0 and step % every == 0:
+            self.sample_memory(step)
+        return breakdown
+
+    def record_aux_compile(self, step: int, what: str) -> None:
+        """Drain compile seconds attributable to auxiliary host-side
+        computations (the log-boundary loss stack, the eval step) so they
+        are NOT misflagged as train-step recompiles at the next step."""
+        compile_s, n = self.compiles.drain()
+        if not n:
+            return
+        self.registry.counter("aux_compiles").inc(n)
+        self.registry.emit(
+            "aux_compile", step=step, what=what,
+            compile_s=round(compile_s, 4), count=n,
+        )
+
+    def record_startup_compile(self) -> None:
+        """Attribute everything compiled so far (init, warmup, resume
+        pre-compile) to 'step 0' — the compile-time-on-first-step number
+        the acceptance criteria pin."""
+        compile_s, n = self.compiles.drain()
+        if n:
+            self._note_startup_compile(compile_s, n)
+
+    def _note_startup_compile(self, compile_s: float, n: int) -> None:
+        """Accumulating, not last-writer-wins: warmup's compile and a
+        warmup-less first step's compile are both startup cost."""
+        g = self.registry.gauge("compile_time_s")
+        total = round((g.value or 0.0) + compile_s, 4)
+        g.set(total)
+        self.registry.emit(
+            "compile", step=0, compile_time_s=round(compile_s, 4), count=n
+        )
+
+    def on_window(self, step: int, *, avg_step_s: float, tokens_per_sec: float,
+                  mfu: float | None) -> None:
+        self.registry.gauge("tokens_per_sec").set(tokens_per_sec)
+        self.registry.gauge("mfu").set(mfu)
+        self.registry.emit(
+            "window",
+            step=step,
+            avg_step_s=round(avg_step_s, 6),
+            tokens_per_sec=round(tokens_per_sec, 1),
+            mfu=None if mfu is None else round(mfu, 4),
+        )
+
+    def emit_train_row(self, step: int, elapsed_time: float, loss: float) -> None:
+        self.registry.emit(
+            "train_row", step=step, elapsed_time=elapsed_time, loss=loss
+        )
+
+    def on_eval(self, step: int, loss: float, duration_s: float | None = None) -> None:
+        self.registry.emit(
+            "eval",
+            step=step,
+            loss=loss,
+            **({} if duration_s is None else {"duration_s": round(duration_s, 4)}),
+        )
+
+    def sample_memory(self, step: int) -> None:
+        samples = sample_memory()
+        peak = peak_hbm_bytes(samples)
+        if peak is not None:
+            g = self.registry.gauge("peak_hbm_bytes")
+            g.set(peak if g.value is None else max(g.value, peak))
+        self.registry.emit("memory", step=step, devices=samples)
+
+    def on_run_end(self, **summary: Any) -> dict[str, Any]:
+        """Emit the run summary (+ cross-host reduction on the lead) and
+        write ``summary.json`` next to the shards."""
+        self.sample_memory(step=-1)
+        # Force the key into the summary even when the backend never
+        # reported stats: an explicit null (CPU) reads differently from a
+        # missing field (telemetry broken).
+        self.registry.gauge("peak_hbm_bytes")
+        body = dict(self.registry.snapshot())
+        body.update(summary)
+        self.registry.emit("run_summary", **body)
+        self.registry.flush()
+        self._barrier()
+        hosts = None
+        if self.lead and self.obs_dir:
+            hosts = reduce_shards(self.obs_dir, self.cfg.straggler_threshold)
+            if hosts is not None:
+                self.registry.emit("hosts", **hosts)
+                if hosts["stragglers"]:
+                    print(
+                        f"[dtc_tpu] WARNING: straggler host(s) {hosts['stragglers']} "
+                        f"(mean step time > {self.cfg.straggler_threshold}x "
+                        "cross-host median)"
+                    )
+            try:
+                with open(os.path.join(self.obs_dir, "summary.json"), "w") as f:
+                    json.dump({"summary": body, "hosts": hosts}, f, indent=2)
+            except OSError as e:
+                print(f"[dtc_tpu] WARNING: could not write summary.json ({e})")
+        return {"summary": body, "hosts": hosts}
+
+    def _barrier(self) -> None:
+        """Cross-host sync between shard flush and reduction: without it
+        the lead reduces while slower hosts' shard tails — exactly the
+        straggler evidence — are still unflushed."""
+        import jax
+
+        if jax.process_count() < 2:
+            return
+        try:
+            from jax.experimental import multihost_utils
+
+            multihost_utils.sync_global_devices("dtc_tpu_obs_reduce")
+        except Exception as e:
+            print(f"[dtc_tpu] WARNING: obs pre-reduce barrier failed ({e})")
+
+    # -- lifecycle --------------------------------------------------------
+    def flush(self) -> None:
+        self.registry.flush()
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        self.profiler.close()
+        self.compiles.deactivate()
+        self.registry.close()

@@ -21,11 +21,72 @@ import optax
 from flax import linen as nn
 from flax import struct
 from flax.training.train_state import TrainState
-from jax.sharding import Mesh
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
 
 from dtc_tpu.parallel.sharding import DEFAULT_RULES
 
 PyTree = Any
+
+
+def normalize_spec(spec: P, mesh: Mesh) -> P:
+    """Canonicalize a PartitionSpec the way GSPMD does: drop mesh axes of
+    size 1 (sharding over them is a no-op) and strip trailing ``None``
+    entries, so ``P(None, 'data', 'model')`` on a model=1 mesh becomes
+    ``P(None, 'data')`` and ``P(None, None)`` becomes ``P()``.
+
+    Initial placement and the step's out_shardings both use this form;
+    without it they disagree with the compiler's normalized outputs and
+    every run pays a second identical-program compile (see
+    :func:`state_shardings`).
+    """
+    def keep(part):
+        if part is None:
+            return None
+        if isinstance(part, str):
+            return part if mesh.shape.get(part, 1) > 1 else None
+        live = tuple(a for a in part if mesh.shape.get(a, 1) > 1)
+        return live if live else None
+
+    parts = [keep(p) for p in spec]
+    while parts and parts[-1] is None:
+        parts.pop()
+    return P(*parts)
+
+
+def state_shardings(state: TrainState, mesh: Mesh) -> PyTree:
+    """Per-leaf NamedShardings of a placed TrainState (replicated P() for
+    any leaf not already carrying a mesh sharding — optax counts, step).
+
+    Used as the step's ``out_shardings`` so the updated state leaves the
+    executable with EXACTLY its input shardings. Without this, GSPMD
+    normalizes degenerate specs (e.g. ``P(None, 'model')`` on a mesh where
+    model=1 collapses to ``P()``), so the first step's donated output no
+    longer matches the second step's input signature and XLA silently
+    compiles a SECOND executable for the same step — a cold-start cost the
+    obs subsystem's compile watcher surfaced (README "Observability").
+    """
+    def leaf(a: Any) -> NamedSharding:
+        if isinstance(a, jax.Array) and isinstance(a.sharding, NamedSharding):
+            return a.sharding
+        return NamedSharding(mesh, P())
+
+    return jax.tree.map(leaf, state)
+
+
+def canonicalize_state_placement(state: TrainState, mesh: Mesh) -> TrainState:
+    """Commit every non-mesh leaf (optax counts on the default device,
+    the Python-int ``step``) to a replicated NamedSharding with a strong
+    dtype, so step N's input signature equals step 1's."""
+    def leaf(a: Any) -> Any:
+        if isinstance(a, jax.Array) and isinstance(a.sharding, NamedSharding):
+            return a
+        arr = jnp.asarray(a)
+        if arr.weak_type:
+            arr = jax.lax.convert_element_type(arr, arr.dtype)
+        return jax.device_put(arr, NamedSharding(mesh, P()))
+
+    return jax.tree.map(leaf, state)
 
 
 @struct.dataclass
@@ -68,18 +129,28 @@ def cross_entropy_loss(logits: jax.Array, targets: jax.Array) -> jax.Array:
 def create_gspmd_train_step(
     mesh: Mesh,
     rules: Sequence[tuple[str, str | None]] = DEFAULT_RULES,
+    state: TrainState | None = None,
 ) -> Callable[[TrainState, Batch, jax.Array], tuple[TrainState, jax.Array]]:
     """Build the jitted DP/TP/DP×TP train step.
 
     The returned function must be called with ``mesh`` / ``rules`` contexts
     active (the trainer owns those); params/opt-state sharding flows in from
     the arguments, batch sharding from the logical ("batch","seq") constraint.
+
+    Passing the (placed) initial ``state`` pins the step's out_shardings to
+    the state's shardings, so every call hits ONE executable — see
+    :func:`state_shardings` for the double-compile this avoids.
     """
+    jit_kwargs: dict[str, Any] = {"donate_argnums": (0,)}
+    if state is not None:
+        jit_kwargs["out_shardings"] = (
+            state_shardings(state, mesh), NamedSharding(mesh, P())
+        )
 
     # Donating the state lets XLA update params/opt-state in place instead of
     # allocating a second ~1.1 GB copy (fp32 master params + two AdamW moments)
     # and copying every step.
-    @functools.partial(jax.jit, donate_argnums=(0,))
+    @functools.partial(jax.jit, **jit_kwargs)
     def train_step(state: TrainState, batch: Batch, rng: jax.Array):
         x = nn.with_logical_constraint(batch.x, ("batch", "seq"))
         y = nn.with_logical_constraint(batch.y, ("batch", "seq"))
@@ -132,10 +203,12 @@ def create_train_step(
     rules: Sequence[tuple[str, str | None]] = DEFAULT_RULES,
     pp_schedule: str = "gpipe",
     pp_virtual: int = 1,
+    state: TrainState | None = None,
 ):
     """Strategy-dispatching factory: GSPMD step, or pipeline step when the
     mesh has a non-trivial ``pipe`` axis (GPipe, or plain/interleaved 1F1B
-    per ``pp_schedule`` / ``pp_virtual``)."""
+    per ``pp_schedule`` / ``pp_virtual``). ``state`` (optional, GSPMD path)
+    pins out_shardings to avoid the layout-churn double compile."""
     if mesh.shape.get("pipe", 1) > 1:
         assert model is not None, "pipeline step needs the model for staged apply"
         if pp_schedule == "1f1b":
@@ -150,4 +223,4 @@ def create_train_step(
         return create_pp_train_step(
             model, mesh, num_microbatches=num_microbatches, rules=rules
         )
-    return create_gspmd_train_step(mesh, rules)
+    return create_gspmd_train_step(mesh, rules, state=state)

@@ -41,11 +41,15 @@ from dtc_tpu.parallel.mesh import mesh_from_config
 from dtc_tpu.parallel.pipeline import pp_param_specs, pp_stack_params
 from dtc_tpu.parallel.sharding import DEFAULT_RULES, batch_spec, param_specs
 from dtc_tpu.train.optimizer import create_optimizer
-from dtc_tpu.train.train_step import Batch, create_train_step
+from dtc_tpu.train.train_step import (
+    Batch,
+    canonicalize_state_placement,
+    create_train_step,
+    normalize_spec,
+)
+from dtc_tpu.obs import Telemetry
 from dtc_tpu.utils.dist import is_lead_process, maybe_initialize_distributed
-from dtc_tpu.utils.logging import CSVLogger
-from dtc_tpu.utils.metrics import mfu
-from dtc_tpu.utils.profiling import StepWindowProfiler
+from dtc_tpu.utils.metrics import comm_bytes_per_step, mfu
 
 PyTree = Any
 
@@ -160,7 +164,14 @@ def init_state(
         )
         specs = pp_param_specs(params, rules)
     else:
-        specs = param_specs(params, rules)
+        # GSPMD-normalized placement (degenerate axes and trailing Nones
+        # dropped) so the step's output shardings equal its input's — one
+        # executable, not two (train_step.state_shardings).
+        specs = jax.tree.map(
+            lambda s: normalize_spec(s, mesh),
+            param_specs(params, rules),
+            is_leaf=lambda x: isinstance(x, P),
+        )
     shardings = jax.tree.map(
         lambda s: NamedSharding(mesh, s), specs, is_leaf=lambda x: isinstance(x, P)
     )
@@ -169,7 +180,11 @@ def init_state(
     # Eager tx.init on sharded params: zeros_like follows input sharding, so
     # the optimizer state lands correctly sharded without an _infer pass
     # (cf. /root/reference/train/train.py:44-52).
-    return TrainState.create(apply_fn=model.apply, params=params, tx=tx)
+    state = TrainState.create(apply_fn=model.apply, params=params, tx=tx)
+    # Commit the stray scalar leaves (optax counts, step) to the mesh so the
+    # step's input signature is identical every call — half of the
+    # double-compile fix (see train_step.state_shardings for the other).
+    return canonicalize_state_placement(state, mesh)
 
 
 def train(
@@ -275,7 +290,7 @@ def _train(
         train_step = create_train_step(
             mesh, model=model, num_microbatches=train_cfg.pp_microbatches,
             rules=rules, pp_schedule=train_cfg.pp_schedule,
-            pp_virtual=train_cfg.pp_virtual_stages,
+            pp_virtual=train_cfg.pp_virtual_stages, state=state,
         )
 
         # Resume parity: the interrupted run consumed warmup_steps +
@@ -380,11 +395,6 @@ def _train(
         # replays the identical RNG stream from any step, unlike a split
         # chain whose position would restart at 0 (round-1 ADVICE).
         key = jax.random.key(train_cfg.seed, impl=train_cfg.prng_impl)
-        profiler = StepWindowProfiler(
-            train_cfg.profile_start,
-            train_cfg.profile_stop,
-            os.path.join(train_cfg.output_dir, "profile"),
-        )
 
         result = TrainResult(state=state, mesh=mesh)
         log_path = os.path.join(train_cfg.output_dir, "log.csv")
@@ -409,10 +419,36 @@ def _train(
                 "checkpointing so the run resumes instead (guards committed "
                 "comparison artifacts against stray smoke runs)"
             )
-        csv = (
-            CSVLogger(log_path)
-            if train_cfg.output_dir and lead
-            else None
+        # Telemetry AFTER the clobber guard (a refused run writes nothing)
+        # but BEFORE warmup, so the compile watcher sees the train step's
+        # XLA compile. All emission — JSONL events, the back-compat
+        # log.csv / eval_log.csv bridges, profiler windows — funnels
+        # through this one object via the hook interface.
+        tele = Telemetry.for_training(
+            train_cfg, lead=lead, process_index=jax.process_index(),
+            resumed=start_step > 0,
+        )
+        # From here to the training loop's own handler, any raise must
+        # close the telemetry: a leaked sink would hold the JSONL shard
+        # open (run_start unflushed) and leave the process-global compile
+        # listener pointed at a dead Telemetry.
+        csv = bool(train_cfg.output_dir and lead)
+        if csv:
+            try:
+                tele.add_csv(log_path, ("step", "elapsed_time", "loss"), "train_row")
+            except BaseException:
+                tele.close()
+                raise
+        tele.on_run_start(
+            strategy=train_cfg.parallel,
+            mesh={k: int(v) for k, v in mesh.shape.items()},
+            devices=num_devices,
+            processes=jax.process_count(),
+            batch=train_cfg.batch,
+            seq_len=model_cfg.max_seq_len,
+            steps=train_cfg.steps,
+            start_step=start_step,
+            dataset=train_cfg.dataset,
         )
         # Auto timing semantics: when rows are being logged, sync each step
         # so elapsed_time is step time, not dispatch time (see schema.py).
@@ -423,39 +459,41 @@ def _train(
         # ------ periodic held-out eval ------
         eval_fn = None
         if train_cfg.eval_every > 0:
-            from dtc_tpu.data.prefetch import split_put
-            from dtc_tpu.train.train_step import create_eval_step
+            try:
+                from dtc_tpu.data.prefetch import split_put
+                from dtc_tpu.train.train_step import create_eval_step
 
-            eval_fn = create_eval_step(mesh, model, rules=rules)
-            spec = batch_spec(rules)
-            if eval_host_batches is not None:
-                # FineWeb: a REAL holdout — every eval_holdout_every-th
-                # batch from the stream head, diverted before training ever
-                # sees it (round-3 VERDICT weak #6; disjointness asserted
-                # in tests/test_data.py).
-                if lead:
-                    print(
-                        f"[dtc_tpu] fineweb eval: {len(eval_host_batches)} "
-                        f"held-out batches (every {holdout_every}th from the "
-                        "stream head), excluded from training"
+                eval_fn = create_eval_step(mesh, model, rules=rules)
+                spec = batch_spec(rules)
+                if eval_host_batches is not None:
+                    # FineWeb: a REAL holdout — every eval_holdout_every-th
+                    # batch from the stream head, diverted before training
+                    # ever sees it (round-3 VERDICT weak #6; disjointness
+                    # asserted in tests/test_data.py).
+                    if lead:
+                        print(
+                            f"[dtc_tpu] fineweb eval: {len(eval_host_batches)} "
+                            f"held-out batches (every {holdout_every}th from "
+                            "the stream head), excluded from training"
+                        )
+                    eval_set = [
+                        split_put(b, mesh, spec) for b in eval_host_batches
+                    ]
+                else:
+                    eval_it = make_eval_iterator(train_cfg, model_cfg)
+                    eval_set = [
+                        split_put(next(eval_it), mesh, spec)
+                        for _ in range(train_cfg.eval_batches)
+                    ]
+                if train_cfg.output_dir and lead:
+                    tele.add_csv(
+                        os.path.join(train_cfg.output_dir, "eval_log.csv"),
+                        ("step", "loss"),
+                        "eval",
                     )
-                eval_set = [
-                    split_put(b, mesh, spec) for b in eval_host_batches
-                ]
-            else:
-                eval_it = make_eval_iterator(train_cfg, model_cfg)
-                eval_set = [
-                    split_put(next(eval_it), mesh, spec)
-                    for _ in range(train_cfg.eval_batches)
-                ]
-            eval_csv = (
-                CSVLogger(
-                    os.path.join(train_cfg.output_dir, "eval_log.csv"),
-                    fieldnames=("step", "loss"),
-                )
-                if train_cfg.output_dir and lead
-                else None
-            )
+            except BaseException:
+                tele.close()
+                raise
 
         def run_eval(step: int) -> float:
             """Returns the wall-clock the eval pass took, so the caller can
@@ -481,10 +519,10 @@ def _train(
             result.eval_losses.append((step, el))
             if lead:
                 print(f"Eval @ step {step}: loss {el:.4f}")
-            if eval_csv:
-                eval_csv.log(step=step, loss=el)
-                eval_csv.flush()
-            return time.perf_counter() - t0
+            dt = time.perf_counter() - t0
+            tele.on_eval(step, el, duration_s=dt)
+            tele.flush()
+            return dt
 
         # ------ preemption safety (SURVEY §5 failure-detection row) ------
         # SIGTERM (the preemption signal on TPU VMs) requests a graceful
@@ -540,6 +578,12 @@ def _train(
                 )
                 jax.device_get(compile_loss)
 
+            # Everything compiled so far (warmup / resume pre-compile) is
+            # the run's startup compile — emitted as the step-0 `compile`
+            # event. With warmup_steps=0 the first timed step pays it and
+            # on_step_end attributes it there instead.
+            tele.record_startup_compile()
+
             # ------ timed loop ------
             if lead:
                 print("Start measuring")
@@ -552,15 +596,23 @@ def _train(
             tokens_per_step = train_cfg.batch * model_cfg.max_seq_len
 
             for step in range(start_step + 1, train_cfg.steps + 1):
-                profiler.step(step)
-                x, y = next(data_it)
-                state, loss = train_step(state, Batch(x=x, y=y), jax.random.fold_in(key, step))
+                tele.on_step_start(step)  # profiler window + step clock
+                with tele.clock.phase("data_wait"):
+                    x, y = next(data_it)
+                with tele.clock.phase("dispatch"):
+                    state, loss = train_step(
+                        state, Batch(x=x, y=y), jax.random.fold_in(key, step)
+                    )
                 device_losses.append(loss)
                 if sync_every_step:
-                    jax.block_until_ready(loss)
+                    with tele.clock.phase("block"):
+                        jax.block_until_ready(loss)
                 now = time.perf_counter()
                 result.elapsed_times.append(now - start_time)
                 pending_rows.append((step, now - start_time))
+                tele.on_step_end(
+                    step, elapsed_s=now - start_time, synced=bool(sync_every_step)
+                )
                 window_steps += 1
 
                 stopping = stop_requested["flag"]
@@ -581,14 +633,21 @@ def _train(
                         pending_rows[-1] = (pending_rows[-1][0], now - start_time)
                         result.elapsed_times[-1] = now - start_time
                     result.losses.extend(losses)
-                    if csv:
-                        for (s, el), lo in zip(pending_rows, losses):
-                            csv.log(step=s, elapsed_time=el, loss=lo)
-                        csv.flush()
+                    # train_row events feed the JSONL stream on every
+                    # process and the log.csv bridge on the lead.
+                    for (s, el), lo in zip(pending_rows, losses):
+                        tele.emit_train_row(s, el, lo)
                     avg_step = (now - window_start) / max(window_steps, 1)
                     u = mfu(
                         model_cfg, train_cfg.batch, model_cfg.max_seq_len, avg_step, num_devices
                     )
+                    tele.on_window(
+                        step,
+                        avg_step_s=avg_step,
+                        tokens_per_sec=tokens_per_step / avg_step,
+                        mfu=u,
+                    )
+                    tele.flush()
                     if lead:
                         msg = (
                             f"Step: {step} | Avg loss: {np.mean(losses):.4f} | "
@@ -599,6 +658,10 @@ def _train(
                             msg += f" | MFU: {u * 100:.1f}%"
                         print(msg)
                     device_losses, pending_rows = [], []
+                    # The loss-stack fetch compiles its own tiny executable
+                    # on the first boundary — attribute it here, not as a
+                    # phantom train-step recompile at the next step.
+                    tele.record_aux_compile(step, "log_boundary")
                     window_start = time.perf_counter()
                     window_steps = 0
 
@@ -606,6 +669,7 @@ def _train(
                     step % train_cfg.eval_every == 0 or step == train_cfg.steps
                 ):
                     eval_dt = run_eval(step)
+                    tele.record_aux_compile(step, "eval")
                     # Keep eval out of both the cumulative elapsed_time (shift
                     # the epoch forward by the eval duration — rows stay pure
                     # training time, comparable to the eval-less reference) and
@@ -615,30 +679,52 @@ def _train(
                     window_steps = 0
 
                 if ckpt and (step % train_cfg.checkpoint_every == 0 or stopping):
+                    tele.registry.counter("checkpoints").inc()
                     ckpt.save(step, state)
                     sidecar_out = stream_position_sidecar(step)
                     if sidecar_out is not None:
                         # Per-process: each pod host's stream position differs.
                         ckpt.save_stream(step, sidecar_out, jax.process_index())
+                    tele.record_aux_compile(step, "checkpoint")
 
                 if stopping:
                     break
+        except BaseException:
+            # A crashed run still keeps its flushed JSONL prefix — same
+            # crash-survival contract as the incremental CSV.
+            tele.close()
+            raise
         finally:
             # Restore even when the loop raises: a stale handler would
             # silently swallow a later (real) SIGTERM.
             if in_main_thread:
                 signal.signal(signal.SIGTERM, prev_handler)
-        profiler.close()
         total = time.perf_counter() - start_time
+        timed_steps = len(result.elapsed_times)
+        comm = comm_bytes_per_step(
+            model_cfg, train_cfg.batch, model_cfg.max_seq_len,
+            {k: int(v) for k, v in mesh.shape.items()},
+            train_cfg.parallel, train_cfg.pp_microbatches,
+        )
+        tele.on_run_end(
+            total_time_s=round(total, 4),
+            steps=timed_steps,
+            tokens_per_sec=(
+                round(tokens_per_step * timed_steps / total, 1) if total > 0 else None
+            ),
+            mfu=(
+                mfu(model_cfg, train_cfg.batch, model_cfg.max_seq_len,
+                    total / timed_steps, num_devices)
+                if timed_steps else None
+            ),
+            est_comm_bytes_per_step=comm,
+        )
+        tele.close()
         if lead:
             print(f"Total time: {total}")
             print("End")
         if ckpt:
             ckpt.wait()
             ckpt.close()
-        if csv:
-            csv.close()
-        if eval_fn is not None and eval_csv:
-            eval_csv.close()
         result.state = state
         return result

@@ -25,6 +25,18 @@ class CSVLogger:
         self._writer.writeheader()
 
     def log(self, **row) -> None:
+        """Write one row. Keys missing from ``fieldnames`` fill blank;
+        unknown keys raise immediately with the valid set — instead of
+        either ``csv.DictWriter``'s opaque ``ValueError`` or (worse) the
+        silent drop that loses a column for an entire run."""
+        if self._fh is None:
+            raise ValueError(f"CSVLogger({self._path!r}) is closed")
+        unknown = set(row) - set(self._fieldnames)
+        if unknown:
+            raise ValueError(
+                f"CSVLogger({self._path!r}): unknown field(s) {sorted(unknown)}; "
+                f"valid fields: {list(self._fieldnames)}"
+            )
         self._writer.writerow({k: row.get(k, "") for k in self._fieldnames})
 
     def flush(self) -> None:
@@ -32,6 +44,8 @@ class CSVLogger:
             self._fh.flush()
 
     def close(self) -> None:
+        """Idempotent — safe to call from both a normal exit path and a
+        ``finally`` block."""
         if self._fh:
             self._fh.close()
             self._fh = None

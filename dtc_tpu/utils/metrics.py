@@ -86,6 +86,72 @@ def moe_step_flops(cfg: ModelConfig, batch: int, seq_len: int) -> float:
     return dense + attn + moe
 
 
+def _dtype_bytes(dtype: str) -> int:
+    return {"float32": 4, "bfloat16": 2, "float16": 2}.get(dtype, 4)
+
+
+def comm_bytes_per_step(
+    cfg: ModelConfig,
+    batch: int,
+    seq_len: int,
+    mesh_shape: dict[str, int],
+    parallel: str,
+    pp_microbatches: int = 1,
+) -> dict[str, float]:
+    """Estimated per-device collective traffic for ONE training step, in
+    bytes, from the active parallelism config — no profiler needed.
+
+    Standard ring-collective accounting (each of the three terms is what
+    the paper's DP/TP/PP comparison trades off):
+
+    - ``dp_allreduce``: gradient all-reduce over the ``data`` axis —
+      ``2·(d-1)/d · P`` bytes per device (reduce-scatter + all-gather),
+      with gradients in ``param_dtype``. FSDP pays the same wire bytes
+      re-phased (param all-gather fwd + bwd, grad reduce-scatter):
+      ``3·(d-1)/d · P``.
+    - ``tp_allreduce``: Megatron TP's two activation all-reduces in
+      forward and two in backward per layer over the ``model`` axis, on
+      ``(B, T, d_model)`` activations in ``compute_dtype``.
+    - ``pp_p2p``: boundary-activation sends between adjacent stages —
+      ``(stages-1)`` cuts crossed forward and backward by every
+      microbatch.
+
+    Returns per-collective estimates plus their ``total``; all terms are
+    0.0 for axes of size 1, so the dict is safe to emit unconditionally.
+    """
+    d_axis = max(mesh_shape.get("data", 1), 1)
+    m_axis = max(mesh_shape.get("model", 1), 1)
+    p_axis = max(mesh_shape.get("pipe", 1), 1)
+    pbytes = _dtype_bytes(cfg.param_dtype)
+    abytes = _dtype_bytes(cfg.compute_dtype)
+    n_params = param_count(cfg)
+
+    dp = 0.0
+    if d_axis > 1:
+        factor = 3.0 if parallel == "fsdp" else 2.0
+        # Per-device parameter share: TP/PP already split the tree.
+        local_params = n_params / (m_axis * p_axis)
+        dp = factor * (d_axis - 1) / d_axis * local_params * pbytes
+
+    tp = 0.0
+    if m_axis > 1:
+        act = batch * seq_len * cfg.d_model * abytes / d_axis  # per-device B shard
+        tp = 4.0 * cfg.n_layers * 2.0 * (m_axis - 1) / m_axis * act
+
+    pp = 0.0
+    if p_axis > 1:
+        micro = batch / max(pp_microbatches, 1) / d_axis
+        act = micro * seq_len * cfg.d_model * abytes
+        pp = 2.0 * (p_axis - 1) * pp_microbatches * act
+
+    return {
+        "dp_allreduce": dp,
+        "tp_allreduce": tp,
+        "pp_p2p": pp,
+        "total": dp + tp + pp,
+    }
+
+
 def mfu(cfg: ModelConfig, batch: int, seq_len: int, step_time_s: float, n_chips: int) -> float | None:
     peak = peak_flops_per_chip()
     if peak is None or step_time_s <= 0:

@@ -1,34 +1,10 @@
-"""Profiler trace capture around a training-step window.
-
-Reference has wall-clock timing only (SURVEY.md §5). This wraps
-``jax.profiler`` so a config-selected step window [start, stop) is captured
-to a TensorBoard/XProf trace directory.
-"""
+"""Back-compat shim: :class:`StepWindowProfiler` moved into the telemetry
+subsystem (``dtc_tpu/obs/profiling.py``), hardened to warn-and-disable on
+an already-active profiler session or an unwritable log dir instead of
+killing the run. Import from :mod:`dtc_tpu.obs` in new code."""
 
 from __future__ import annotations
 
-import jax
+from dtc_tpu.obs.profiling import StepWindowProfiler
 
-
-class StepWindowProfiler:
-    def __init__(self, start_step: int, stop_step: int, log_dir: str):
-        self.start = start_step
-        self.stop = stop_step
-        self.log_dir = log_dir
-        self._active = False
-        self.enabled = stop_step > start_step
-
-    def step(self, step: int) -> None:
-        if not self.enabled:
-            return
-        if step == self.start and not self._active:
-            jax.profiler.start_trace(self.log_dir)
-            self._active = True
-        elif step == self.stop and self._active:
-            jax.profiler.stop_trace()
-            self._active = False
-
-    def close(self) -> None:
-        if self._active:
-            jax.profiler.stop_trace()
-            self._active = False
+__all__ = ["StepWindowProfiler"]

@@ -26,12 +26,17 @@ from dtc_tpu.train.trainer import train
     "--dataset", default=None, type=click.Choice(["fineweb", "synthetic"]),
     help="override dataset",
 )
+@click.option(
+    "--obs/--no-obs", "obs", default=None,
+    help="force the telemetry subsystem on/off (default: ObsConfig from YAML)",
+)
 def main(
     train_config_path: str,
     model_config_path: str | None,
     optim_config_path: str | None,
     steps: int | None,
     dataset: str | None,
+    obs: bool | None,
 ):
     train_cfg, model_cfg, opt_cfg = load_config(
         train_config_path, model_config_path, optim_config_path
@@ -40,6 +45,8 @@ def main(
         train_cfg = replace(train_cfg, steps=steps)
     if dataset is not None:
         train_cfg = replace(train_cfg, dataset=dataset)
+    if obs is not None:
+        train_cfg = replace(train_cfg, obs=replace(train_cfg.obs, enabled=obs))
 
     # Multi-host init FIRST: jax.distributed.initialize() must run before
     # any backend-touching JAX API (including jax.device_count below).
@@ -57,6 +64,11 @@ def main(
 
     print(f"Running `{train_cfg.parallel}` on {jax.device_count()} devices.")
     train(train_cfg, model_cfg, opt_cfg)
+    if train_cfg.obs.enabled and train_cfg.obs.jsonl and train_cfg.output_dir:
+        import os
+
+        obs_dir = train_cfg.obs.dir or os.path.join(train_cfg.output_dir, "obs")
+        print(f"Telemetry: {obs_dir}/events.r*.jsonl + summary.json")
 
 
 if __name__ == "__main__":

@@ -50,7 +50,10 @@ def build_step(batch=32, heads=16, max_seq_len=512, dropout=0.1, remat=True,
     model = GPT(model_cfg)
     with mesh, nn.logical_axis_rules(DEFAULT_RULES):
         state = init_state(model, model_cfg, train_cfg, opt_cfg, mesh, DEFAULT_RULES)
-        step_fn = create_train_step(mesh, model=model)
+        # state= pins out_shardings so the step compiles ONCE (see
+        # train_step.state_shardings — without it GSPMD layout churn pays
+        # a second identical cold compile on the call after warmup step 1).
+        step_fn = create_train_step(mesh, model=model, state=state)
     tok = next(synthetic_batch_iterator(batch, max_seq_len + 1, model_cfg.vocab_size))
     batch_obj = Batch(x=jnp.asarray(tok[:, :-1]), y=jnp.asarray(tok[:, 1:]))
     key = jax.random.key(0, impl="rbg")
