@@ -186,6 +186,113 @@ class ObsConfig:
 
 
 @dataclass(frozen=True)
+class GuardConfig:
+    """Anomaly guard (``dtc_tpu/resilience/guard.py``): loss-health checks
+    at log boundaries (no extra per-step device sync) with a policy ladder
+    skip-update -> rollback-to-verified-checkpoint -> clean abort."""
+
+    enabled: bool = True
+    # Window mean > spike_factor x trailing median of healthy windows is an
+    # anomaly; 0 disables the spike check (non-finite is always checked).
+    spike_factor: float = 0.0
+    spike_window: int = 32       # trailing window-means kept for the median
+    max_rollbacks: int = 3       # ladder rung 3: abort after this many
+    # Rung 1: wrap the optimizer in optax.apply_if_finite so non-finite
+    # updates are SKIPPED device-side (no sync). Changes the optimizer
+    # state pytree — checkpoints do not carry across toggling this.
+    skip_nonfinite_updates: bool = False
+    max_consecutive_skips: int = 10  # bad windows tolerated before rollback
+
+    def __post_init__(self) -> None:
+        if self.spike_factor < 0:
+            raise ValueError("spike_factor must be >= 0 (0 = disabled)")
+        if self.max_rollbacks < 0:
+            raise ValueError("max_rollbacks must be >= 0")
+
+
+@dataclass(frozen=True)
+class WatchdogConfig:
+    """Hung-step watchdog (``dtc_tpu/resilience/watchdog.py``): flags steps
+    exceeding ``factor`` x the trailing median via telemetry; optionally
+    arms a profiler window on the first flag and hard-aborts steps that
+    never complete."""
+
+    enabled: bool = False
+    factor: float = 8.0          # duration > factor x trailing median flags
+    min_samples: int = 5         # steps observed before the median is trusted
+    hard_timeout_s: float = 0.0  # 0 = never abort; >0 = WatchdogTimeout
+    profile_on_flag: bool = False  # arm a 2-step profiler window when flagged
+
+    def __post_init__(self) -> None:
+        if self.factor <= 1.0:
+            raise ValueError(f"watchdog factor must be > 1.0, got {self.factor}")
+        if self.hard_timeout_s < 0:
+            raise ValueError("hard_timeout_s must be >= 0")
+
+
+@dataclass(frozen=True)
+class StreamRetryConfig:
+    """Self-healing data stream (``dtc_tpu/resilience/retry.py``): transient
+    HF-streaming faults re-open the source at the exact consumed position
+    (``ds.skip``) with exponential backoff + jitter, bounded attempts."""
+
+    enabled: bool = True
+    max_attempts: int = 5        # consecutive failures before DataStreamError
+    backoff_s: float = 1.0       # first-retry delay; doubles per attempt
+    backoff_max_s: float = 30.0
+    jitter: float = 0.1          # +/- fraction of the delay
+
+    def __post_init__(self) -> None:
+        if self.max_attempts < 1:
+            raise ValueError("max_attempts must be >= 1")
+        if self.backoff_s < 0 or self.backoff_max_s < 0 or self.jitter < 0:
+            raise ValueError("backoff/jitter values must be >= 0")
+
+
+@dataclass(frozen=True)
+class ChaosConfig:
+    """Deterministic fault injection (``dtc_tpu/resilience/chaos.py``).
+
+    Dev/test only — every fault fires EXACTLY ONCE per run at its trigger
+    (0 disables a fault; ``enabled: false`` disables the harness). Faults
+    land on the production code paths: the data fault is raised underneath
+    the stream retry wrapper, the corruption hits real checkpoint files,
+    the preemption is a real SIGTERM.
+    """
+
+    enabled: bool = False
+    data_error_at_doc: int = 0    # transient stream error before raw doc N (1-based)
+    data_stall_at_doc: int = 0    # sleep stall_s before raw doc N (watchdog fodder)
+    stall_s: float = 0.0
+    corrupt_ckpt_at_step: int = 0  # damage the checkpoint written at step N
+    corrupt_mode: str = "truncate"  # truncate | flip
+    nan_at_step: int = 0          # poison params+loss with NaN after step N
+    sigterm_at_step: int = 0      # simulated preemption after step N
+
+    def __post_init__(self) -> None:
+        if self.corrupt_mode not in ("truncate", "flip"):
+            raise ValueError(f"unknown corrupt_mode {self.corrupt_mode!r}")
+        if self.stall_s < 0:
+            raise ValueError("stall_s must be >= 0")
+
+
+@dataclass(frozen=True)
+class ResilienceConfig:
+    """Fault-tolerance subsystem knobs (``dtc_tpu/resilience/``). See
+    README "Fault tolerance" for recovery semantics."""
+
+    guard: GuardConfig = field(default_factory=GuardConfig)
+    watchdog: WatchdogConfig = field(default_factory=WatchdogConfig)
+    stream_retry: StreamRetryConfig = field(default_factory=StreamRetryConfig)
+    chaos: ChaosConfig = field(default_factory=ChaosConfig)
+    # Verified checkpoints (checksum manifest + intact-step fallback).
+    # Costs the async-save overlap: every save waits for Orbax and the
+    # lead process sha256-hashes the step. Turn off to restore pure async
+    # saves when save cadence dominates (no integrity fallback then).
+    verify_checkpoints: bool = True
+
+
+@dataclass(frozen=True)
 class TrainConfig:
     """Training-run configuration.
 
@@ -244,7 +351,15 @@ class TrainConfig:
     # Telemetry subsystem (JSONL events, step breakdown, memory sampling,
     # multi-host reduction) — see ObsConfig above.
     obs: ObsConfig = field(default_factory=ObsConfig)
+    # Fault tolerance: anomaly guard, watchdog, stream retry, chaos
+    # injection — see ResilienceConfig above and README "Fault tolerance".
+    resilience: ResilienceConfig = field(default_factory=ResilienceConfig)
     multihost: bool = False      # call jax.distributed.initialize()
+    # Coordinator-init timeout for jax.distributed.initialize (seconds);
+    # 0 = jax's default (300s). Env knob DTC_COORDINATOR_TIMEOUT_S
+    # overrides. SURVEY §5: a wrong coordinator address used to hang the
+    # whole pod forever with no message.
+    coordinator_timeout_s: int = 0
     prng_impl: str = "threefry2x32"  # dropout PRNG; "rbg" is ~4% faster on TPU
     # Dev-config NaN sanitizer (SURVEY §5): enables jax_debug_nans for the
     # duration of the run — any jitted computation producing NaN re-runs
@@ -275,6 +390,8 @@ class TrainConfig:
             raise ValueError("eval_holdout_every must be >= 1")
         if self.prng_impl not in ("threefry2x32", "rbg", "unsafe_rbg"):
             raise ValueError(f"unknown prng_impl {self.prng_impl!r}")
+        if self.coordinator_timeout_s < 0:
+            raise ValueError("coordinator_timeout_s must be >= 0 (0 = default)")
         if self.batch % self.pp_microbatches != 0:
             raise ValueError(
                 f"batch={self.batch} not divisible by pp_microbatches={self.pp_microbatches}"

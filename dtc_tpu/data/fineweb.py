@@ -47,17 +47,55 @@ def stride_documents(
             yield item
 
 
-def _document_tokens(
-    tokenizer, process_index: int, process_count: int, raw_skip: int = 0
-) -> Iterator[list[int]]:
-    from datasets import load_dataset  # network-bound import kept local
+def _wrap_resilient(
+    open_at, raw_skip: int, retry=None, chaos=None, on_recovery=None,
+    cancel=None,
+) -> Iterator:
+    """Compose the raw-document source with the chaos hook (inside, so the
+    injected fault exercises the real healing path) and the retry wrapper
+    (outside): one uninterrupted, exactly-once document sequence across any
+    number of re-opens. ``open_at(index)`` returns a fresh raw iterator
+    whose first item has absolute index ``index``."""
+    def factory(index: int) -> Iterator:
+        it = open_at(index)
+        if chaos is not None:
+            it = chaos.wrap_raw_documents(it, index)
+        return it
 
-    ds = load_dataset("HuggingFaceFW/fineweb-edu", split="train", streaming=True)
-    if raw_skip:
-        # Server/shard-aware skip: the resumed run does not re-download or
-        # re-tokenize already-consumed documents.
-        ds = ds.skip(raw_skip)
-    for item in stride_documents(ds, process_index, process_count, raw_skip):
+    if retry is None or not getattr(retry, "enabled", True):
+        return factory(raw_skip)
+    from dtc_tpu.resilience.retry import resilient_iterator
+
+    return resilient_iterator(
+        factory,
+        start_index=raw_skip,
+        max_attempts=retry.max_attempts,
+        backoff_s=retry.backoff_s,
+        backoff_max_s=retry.backoff_max_s,
+        jitter=retry.jitter,
+        on_event=on_recovery,
+        cancel=cancel,
+    )
+
+
+def _document_tokens(
+    tokenizer, process_index: int, process_count: int, raw_skip: int = 0,
+    retry=None, chaos=None, on_recovery=None, cancel=None,
+) -> Iterator[list[int]]:
+    def open_at(index: int) -> Iterator:
+        from datasets import load_dataset  # network-bound import kept local
+
+        ds = load_dataset(
+            "HuggingFaceFW/fineweb-edu", split="train", streaming=True
+        )
+        if index:
+            # Server/shard-aware skip: neither a resumed run nor a
+            # mid-stream retry re-downloads or re-tokenizes consumed docs.
+            ds = ds.skip(index)
+        return iter(ds)
+
+    raw = _wrap_resilient(open_at, raw_skip, retry, chaos, on_recovery, cancel)
+    for item in stride_documents(raw, process_index, process_count, raw_skip):
         yield tokenizer.encode(item["text"])
 
 
@@ -72,7 +110,10 @@ class FinewebStream:
     even while the prefetch pipeline has pulled a few batches ahead.
 
     ``documents`` injects a pre-tokenized RAW document stream (tests /
-    offline); it is striped and skipped exactly like the network path.
+    offline); it is striped and skipped exactly like the network path — and
+    when given as a SEQUENCE it is also re-openable, so the self-healing
+    retry path (``retry``/``chaos``) runs end-to-end offline in tier-1
+    tests exactly as it would against HuggingFace streaming.
     """
 
     def __init__(
@@ -86,6 +127,10 @@ class FinewebStream:
         documents: Iterator[list[int]] | None = None,
         position: dict | None = None,
         history: int = 64,
+        retry=None,
+        chaos=None,
+        on_recovery=None,
+        cancel=None,
     ):
         pos = position or {"docs_consumed": 0, "buffer": []}
         skip = int(pos["docs_consumed"])  # STRIPED documents already consumed
@@ -97,14 +142,23 @@ class FinewebStream:
             if hasattr(documents, "__getitem__"):
                 # Sequence: true seek (mirrors the network path's ds.skip) —
                 # already-consumed documents are never touched again, which
-                # the resume tests assert.
-                raw = iter(documents[raw_skip:])
+                # the resume tests assert. Re-openable, so retry/chaos
+                # compose exactly like the network path.
+                raw = _wrap_resilient(
+                    lambda index: iter(documents[index:]),
+                    raw_skip, retry, chaos, on_recovery, cancel,
+                )
             else:
+                # A plain iterator cannot be re-opened: no healing possible.
                 raw = itertools.islice(documents, raw_skip, None)
+                if chaos is not None:
+                    raw = chaos.wrap_raw_documents(raw, raw_skip)
             docs = stride_documents(raw, process_index, process_count, raw_skip)
         else:
             docs = _document_tokens(
-                tokenizer or get_tokenizer(), process_index, process_count, raw_skip
+                tokenizer or get_tokenizer(), process_index, process_count,
+                raw_skip, retry=retry, chaos=chaos, on_recovery=on_recovery,
+                cancel=cancel,
             )
         self._packer = TokenPacker(
             docs, batch_size, seq_len, docs_consumed=skip, buffer=pos["buffer"]

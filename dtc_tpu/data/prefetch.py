@@ -47,7 +47,19 @@ class ShardedPrefetchIterator:
     inputs/targets (x = [:, :-1], y = [:, 1:], as the reference does at
     /root/reference/train/train.py:76-77) and device_puts with the batch
     PartitionSpec. ``queue_size=0`` degrades to fully synchronous feeding.
+
+    Failure contract (SURVEY §5 "a data-stream error kills the run" — as a
+    hang, the worst way): an exception inside the worker thread reaches the
+    consumer as the ORIGINAL exception (error + sentinel through the queue);
+    a worker that dies without even delivering its sentinel — interpreter
+    teardown, a C-level crash in the tokenizer — surfaces as a typed
+    :class:`~dtc_tpu.resilience.errors.DataStreamError` via a bounded-wait
+    liveness check instead of blocking ``get()`` forever. ``close()`` shuts
+    the worker down so a trainer rollback can rebuild the pipeline without
+    leaking threads.
     """
+
+    _POLL_S = 1.0  # consumer liveness-check cadence; never limits throughput
 
     def __init__(
         self,
@@ -62,6 +74,9 @@ class ShardedPrefetchIterator:
         self._queue_size = queue_size
         self._queue: queue.Queue | None = None
         self._err: BaseException | None = None
+        self._done = False
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
         if queue_size > 0:
             self._queue = queue.Queue(maxsize=queue_size)
             self._thread = threading.Thread(target=self._worker, daemon=True)
@@ -70,14 +85,27 @@ class ShardedPrefetchIterator:
     def _split_put(self, batch: np.ndarray):
         return split_put(batch, self._mesh, self._spec)
 
+    def _put(self, item) -> bool:
+        """Bounded put that aborts when the consumer called close() — a
+        full queue with a departed consumer must not pin the thread."""
+        while not self._stop.is_set():
+            try:
+                self._queue.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
     def _worker(self):
         try:
             for batch in self._it:
-                self._queue.put(self._split_put(batch))
+                if not self._put(self._split_put(batch)):
+                    return  # closed: skip the sentinel, nobody is reading
         except BaseException as e:  # surfaced on the consumer side
             self._err = e
         finally:
-            self._queue.put(None)
+            if not self._stop.is_set():
+                self._put(None)
 
     def __iter__(self):
         return self
@@ -85,9 +113,46 @@ class ShardedPrefetchIterator:
     def __next__(self):
         if self._queue is None:
             return self._split_put(next(self._it))
-        item = self._queue.get()
+        if self._done:
+            raise StopIteration  # sentinel already consumed; stay iterable
+        while True:
+            try:
+                item = self._queue.get(timeout=self._POLL_S)
+                break
+            except queue.Empty:
+                if self._thread is not None and not self._thread.is_alive():
+                    # The worker may have put its final sentinel and exited
+                    # in the instant our timeout expired — drain once more
+                    # before declaring it dead, or a clean end-of-stream
+                    # becomes a spurious crash.
+                    try:
+                        item = self._queue.get_nowait()
+                        break
+                    except queue.Empty:
+                        pass
+                    from dtc_tpu.resilience.errors import DataStreamError
+
+                    raise DataStreamError(
+                        "prefetch worker thread died without delivering a "
+                        "batch or an error sentinel"
+                    ) from self._err
         if item is None:
+            self._done = True
             if self._err is not None:
                 raise self._err
             raise StopIteration
         return item
+
+    def close(self) -> None:
+        """Stop the worker and release the queue. Idempotent; safe to call
+        from the consumer at any point (e.g. trainer rollback)."""
+        self._stop.set()
+        if self._queue is not None:
+            # Unblock a worker stuck in put() by draining.
+            try:
+                while True:
+                    self._queue.get_nowait()
+            except queue.Empty:
+                pass
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)

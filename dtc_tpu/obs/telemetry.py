@@ -30,6 +30,14 @@ Event stream schema (JSONL, one shard per process — see README
 - ``eval``         — held-out eval loss (bridged to ``eval_log.csv``);
 - ``memory``       — per-device HBM sample (``null`` stats on CPU);
 - ``hosts``        — cross-host reduction + straggler flags (lead only);
+- ``chaos``        — a fault-injection hook fired (``kind``: data_error,
+                     data_stall, ckpt_corrupt, nan_loss, sigterm);
+- ``anomaly``      — the guard detected an unhealthy loss window
+                     (``reason``, chosen ``action``);
+- ``recovery``     — a recovery action executed (``action``: stream_retry,
+                     ckpt_fallback, rollback, tolerate, abort);
+- ``hung_step``    — watchdog flag: a step exceeded the configured multiple
+                     of the trailing median step time;
 - ``run_summary``  — totals: tokens/s, MFU, peak HBM, compile/recompile
                      counts, est. comm bytes per step.
 """
@@ -223,6 +231,45 @@ class Telemetry:
             loss=loss,
             **({} if duration_s is None else {"duration_s": round(duration_s, 4)}),
         )
+
+    # -- resilience hooks --------------------------------------------------
+    def on_anomaly(self, step: int, *, reason: str, action: str) -> None:
+        self.registry.counter("anomalies").inc()
+        self.registry.emit("anomaly", step=step, reason=reason, action=action)
+
+    def on_recovery(self, step: int, *, action: str, **fields: Any) -> None:
+        self.registry.counter("recoveries").inc()
+        self.registry.emit("recovery", step=step, action=action, **fields)
+
+    def on_hung_step(self, step: int, **fields: Any) -> None:
+        self.registry.counter("hung_steps").inc()
+        self.registry.emit("hung_step", step=step, **fields)
+
+    def drain_recovery_bus(self, bus: Any, step: int) -> None:
+        """Move pending chaos/recovery records (posted from threads and
+        layers with no telemetry handle — see resilience.events) into the
+        event stream, stamped with the step they surfaced at."""
+        for etype, fields in bus.drain():
+            if etype == "chaos":
+                self.registry.counter("chaos_injections").inc()
+            elif etype == "recovery":
+                self.registry.counter("recoveries").inc()
+            # Keep the poster's own step (e.g. a chaos trigger step) when it
+            # recorded one; otherwise stamp the boundary it surfaced at.
+            fields.setdefault("step", step)
+            self.registry.emit(etype, **fields)
+
+    def arm_profile_window(self, start_step: int, n_steps: int = 2) -> bool:
+        """Point the profiler at ``[start_step, start_step + n_steps)`` —
+        used by the watchdog to capture a trace after a hung-step flag.
+        No-op (False) when a window is already configured/active or the
+        profiler previously failed."""
+        p = self.profiler
+        if p.enabled or p.failed or not p.log_dir:
+            return False
+        p.start, p.stop = start_step, start_step + n_steps
+        p.enabled = True
+        return True
 
     def sample_memory(self, step: int) -> None:
         samples = sample_memory()

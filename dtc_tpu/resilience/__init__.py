@@ -1,0 +1,46 @@
+"""Fault-tolerant training subsystem (SURVEY §5 "failure detection" row).
+
+The production posture (ROADMAP north star: preemptible pods, heavy
+traffic) treats recovery as a first-class subsystem, not an afterthought:
+
+- :mod:`~dtc_tpu.resilience.chaos` — deterministic fault injection so every
+  recovery path runs in tier-1 CPU tests;
+- :mod:`~dtc_tpu.resilience.guard` — loss-anomaly policy ladder
+  (skip-update -> rollback to verified checkpoint -> clean abort);
+- :mod:`~dtc_tpu.resilience.retry` — position-preserving stream retry
+  (heals transient HF-streaming faults bit-exactly);
+- :mod:`~dtc_tpu.resilience.watchdog` — hung-step flagging + hard timeout;
+- :mod:`~dtc_tpu.resilience.events` — thread-safe bus that feeds recovery
+  actions into the telemetry stream;
+- :mod:`~dtc_tpu.resilience.errors` — the catchable failure taxonomy.
+
+See README "Fault tolerance" for recovery semantics and the chaos config
+reference.
+"""
+
+from dtc_tpu.resilience.chaos import ChaosInjector
+from dtc_tpu.resilience.errors import (
+    AnomalyAbort,
+    ChaosInjectedError,
+    DataStreamError,
+    ResilienceError,
+    WatchdogTimeout,
+)
+from dtc_tpu.resilience.events import RecoveryBus
+from dtc_tpu.resilience.guard import AnomalyGuard, GuardDecision
+from dtc_tpu.resilience.retry import resilient_iterator
+from dtc_tpu.resilience.watchdog import StepWatchdog
+
+__all__ = [
+    "AnomalyAbort",
+    "AnomalyGuard",
+    "ChaosInjectedError",
+    "ChaosInjector",
+    "DataStreamError",
+    "GuardDecision",
+    "RecoveryBus",
+    "ResilienceError",
+    "StepWatchdog",
+    "WatchdogTimeout",
+    "resilient_iterator",
+]

@@ -1,0 +1,127 @@
+"""Deterministic fault injection (the chaos harness).
+
+Every recovery path in the trainer is exercisable on CPU by configuring a
+``resilience.chaos`` block: at a chosen step (or raw-document index) the
+injector raises a transient data-stream error, stalls the stream, corrupts
+the just-written checkpoint, poisons the model state + loss with NaN, or
+delivers a real SIGTERM (the preemption signal). Each fault fires EXACTLY
+once per injector instance, so a healed run does not re-injure itself after
+rollback — and the tier-1 tests can assert one ``recovery`` event per
+injection.
+
+The injector sits on the production code paths, never beside them: the data
+fault is raised underneath the same retry wrapper that heals real network
+errors, the checkpoint corruption hits real Orbax files on disk, and the
+simulated preemption goes through the process signal handler.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Any, Iterator
+
+from dtc_tpu.resilience.errors import ChaosInjectedError
+from dtc_tpu.resilience.events import RecoveryBus
+
+
+class ChaosInjector:
+    """Config-driven, fire-once fault injection hooks.
+
+    Construct one per training run from ``ResilienceConfig.chaos``; the
+    trainer threads it into the data pipeline and consults it at step
+    boundaries. With ``cfg.enabled`` false every hook is an inert no-op
+    (the trainer normally skips constructing one at all).
+    """
+
+    def __init__(self, cfg: Any, bus: RecoveryBus | None = None):
+        self.cfg = cfg
+        self.bus = bus
+        self._fired: set[str] = set()
+
+    def _fire(self, key: str, **fields: Any) -> bool:
+        """True exactly once per fault key; posts a ``chaos`` event."""
+        if not self.cfg.enabled or key in self._fired:
+            return False
+        self._fired.add(key)
+        if self.bus is not None:
+            self.bus.post("chaos", kind=key, **fields)
+        print(f"[dtc_tpu] CHAOS: injecting {key} ({fields})")
+        return True
+
+    # ---- data plane (runs on the stream/prefetch thread) -----------------
+    def wrap_raw_documents(
+        self, it: Iterator[Any], start_index: int
+    ) -> Iterator[Any]:
+        """Wrap a raw document iterator whose first item has absolute index
+        ``start_index``. Raises a transient :class:`ChaosInjectedError`
+        (or sleeps ``stall_s``) immediately BEFORE the configured 1-based
+        document index — i.e. after ``N-1`` documents were consumed, which
+        is exactly where a mid-stream network fault lands."""
+        index = start_index
+        for item in it:
+            if index + 1 == self.cfg.data_stall_at_doc and self._fire(
+                "data_stall", doc=index + 1, stall_s=self.cfg.stall_s
+            ):
+                time.sleep(self.cfg.stall_s)
+            if index + 1 == self.cfg.data_error_at_doc and self._fire(
+                "data_error", doc=index + 1
+            ):
+                raise ChaosInjectedError(
+                    f"chaos: injected transient stream fault before raw "
+                    f"document {index + 1}"
+                )
+            index += 1
+            yield item
+
+    # ---- trainer plane ---------------------------------------------------
+    def maybe_poison(self, step: int, state: Any, loss: Any):
+        """After the update at ``step``: replace the loss with NaN and blow
+        up the parameters (NaN), simulating a diverged/poisoned update the
+        anomaly guard must detect and roll back. Shapes and shardings are
+        preserved so the step executable is untouched."""
+        if step != self.cfg.nan_at_step or not self._fire("nan_loss", step=step):
+            return state, loss
+        import jax
+        import jax.numpy as jnp
+
+        nan_params = jax.tree.map(
+            lambda p: p * jnp.asarray(float("nan"), dtype=p.dtype), state.params
+        )
+        return state.replace(params=nan_params), loss * float("nan")
+
+    def should_preempt(self, step: int) -> bool:
+        """Simulated preemption: the trainer delivers a real SIGTERM to the
+        process, exercising the graceful-stop handler end to end."""
+        return step == self.cfg.sigterm_at_step and self._fire(
+            "sigterm", step=step
+        )
+
+    def maybe_corrupt_checkpoint(self, step: int, step_dir: str) -> bool:
+        """After the checkpoint at ``step`` was fully written (manifest
+        included): damage the largest file under its directory —
+        ``truncate`` chops it in half, ``flip`` inverts a mid-file byte
+        window — so integrity verification must catch it later."""
+        if step != self.cfg.corrupt_ckpt_at_step or not self._fire(
+            "ckpt_corrupt", step=step, mode=self.cfg.corrupt_mode
+        ):
+            return False
+        target, size = None, -1
+        for root, _, files in os.walk(step_dir):
+            for name in files:
+                p = os.path.join(root, name)
+                s = os.path.getsize(p)
+                if s > size:
+                    target, size = p, s
+        if target is None:
+            return False
+        if self.cfg.corrupt_mode == "truncate":
+            with open(target, "r+b") as f:
+                f.truncate(size // 2)
+        else:  # flip
+            with open(target, "r+b") as f:
+                f.seek(size // 2)
+                chunk = f.read(min(64, max(size - size // 2, 1)))
+                f.seek(size // 2)
+                f.write(bytes(b ^ 0xFF for b in chunk))
+        return True

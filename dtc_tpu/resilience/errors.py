@@ -1,0 +1,38 @@
+"""Typed failure taxonomy for the resilience subsystem.
+
+Every recovery path needs a *catchable* error class: the trainer's policy
+ladder, the prefetch consumer, and external launchers all branch on these
+types instead of string-matching arbitrary exceptions. ``ChaosInjectedError``
+deliberately subclasses ``ConnectionError`` so injected data faults travel
+the exact same retry/classification path a real HuggingFace network error
+would — the chaos harness tests the production path, not a parallel one.
+"""
+
+from __future__ import annotations
+
+
+class ResilienceError(RuntimeError):
+    """Base class for failures raised by the resilience subsystem itself."""
+
+
+class DataStreamError(ResilienceError):
+    """The input stream is dead beyond repair: retries exhausted, or the
+    prefetch worker thread died without delivering its error sentinel.
+    Carries the last underlying exception as ``__cause__`` when known."""
+
+
+class AnomalyAbort(ResilienceError):
+    """The anomaly guard's policy ladder is exhausted (``max_rollbacks``
+    rollbacks already spent, training still diverging) — a clean abort so
+    an external supervisor can restart from the last verified checkpoint."""
+
+
+class WatchdogTimeout(ResilienceError):
+    """A training step exceeded the watchdog's hard timeout."""
+
+
+class ChaosInjectedError(ConnectionError):
+    """Deterministic fault raised by the chaos harness into the data plane.
+
+    Subclasses ``ConnectionError`` on purpose: the stream retry wrapper
+    must treat it exactly like a real transient network failure."""

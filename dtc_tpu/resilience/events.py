@@ -1,0 +1,44 @@
+"""Thread-safe recovery event bus.
+
+Recovery actions happen in places that have no telemetry handle: the stream
+retry wrapper fires on the prefetch worker thread, checkpoint fallback fires
+inside ``CheckpointManager`` before the trainer's ``Telemetry`` even exists.
+They post here; the trainer drains the bus at step/log boundaries into the
+telemetry stream (``chaos`` / ``recovery`` / ``anomaly`` event kinds), so
+every recovery action lands in the JSONL shard with a step attribution and
+nothing in the data plane ever imports the obs subsystem.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any
+
+
+class RecoveryBus:
+    """Bounded, thread-safe list of pending (etype, fields) event tuples."""
+
+    def __init__(self, maxlen: int = 1024):
+        self._lock = threading.Lock()
+        self._events: list[tuple[str, dict[str, Any]]] = []
+        self._dropped = 0
+        self._maxlen = maxlen
+
+    def post(self, etype: str, **fields: Any) -> None:
+        with self._lock:
+            if len(self._events) >= self._maxlen:
+                # A runaway retry loop must not turn the bus into a memory
+                # leak; drops are counted and surfaced on the next drain.
+                self._dropped += 1
+                return
+            self._events.append((etype, dict(fields)))
+
+    def drain(self) -> list[tuple[str, dict[str, Any]]]:
+        with self._lock:
+            out, self._events = self._events, []
+            if self._dropped:
+                out.append(("recovery", {
+                    "action": "bus_overflow", "dropped": self._dropped,
+                }))
+                self._dropped = 0
+            return out

@@ -1,0 +1,137 @@
+"""Anomaly guard: loss-health checks with a recovery policy ladder.
+
+Checks run at LOG BOUNDARIES on the window of losses the trainer already
+fetched for logging — zero additional per-step device syncs. Two anomaly
+classes:
+
+- **non-finite** — any NaN/inf loss in the window (a poisoned update, a
+  fused-kernel bug, bad data);
+- **spike** — window mean above ``spike_factor`` x the trailing median of
+  healthy window means (``spike_factor: 0`` disables; loss is noisy early
+  in training, so this is opt-in).
+
+The policy ladder (MegaScale-style, cheapest rung first):
+
+1. **tolerate/skip** — when device-side update skipping is on
+   (``skip_nonfinite_updates``, see ``optax.apply_if_finite`` in
+   ``train/optimizer.py``), a non-finite window may be transient: the
+   optimizer already dropped the bad updates, so the guard tolerates up to
+   ``max_consecutive_skips`` consecutive bad windows before escalating.
+2. **rollback** — restore the last *verified* checkpoint and re-seek the
+   data stream (the trainer owns the mechanics); at most ``max_rollbacks``
+   per run.
+3. **abort** — raise :class:`AnomalyAbort` so a supervisor restarts the
+   job from the last good checkpoint instead of burning accelerator time
+   on a diverged run.
+
+Without a checkpoint manager there is nothing to roll back to: the guard
+then only reports (``anomaly`` events) — silently continuing is today's
+behavior and aborting would destroy the very state a human might inspect.
+"""
+
+from __future__ import annotations
+
+import math
+from collections import deque
+from dataclasses import dataclass
+from typing import Any
+
+
+@dataclass
+class GuardDecision:
+    action: str          # "ok" | "warn" | "tolerate" | "rollback" | "abort"
+    reason: str = ""
+
+    @property
+    def anomalous(self) -> bool:
+        return self.action != "ok"
+
+
+class AnomalyGuard:
+    def __init__(self, cfg: Any, *, can_rollback: bool):
+        self.cfg = cfg
+        self.can_rollback = can_rollback
+        self.rollbacks_done = 0
+        self._consecutive_bad = 0
+        # Trailing window means of HEALTHY windows only — an anomaly must
+        # not drag the median toward itself.
+        self._means: deque[float] = deque(maxlen=max(int(cfg.spike_window), 2))
+
+    # -- detection ---------------------------------------------------------
+    def _trailing_median(self) -> float | None:
+        # Minimum history before the median is trusted — capped at the
+        # deque's own maxlen so a small spike_window cannot silently
+        # disable the check the user just configured.
+        if len(self._means) < min(4, self._means.maxlen):
+            return None
+        return sorted(self._means)[len(self._means) // 2]
+
+    def _classify(self, losses: list[float]) -> str | None:
+        if any(not math.isfinite(v) for v in losses):
+            return "non-finite loss"
+        if self.cfg.spike_factor > 0:
+            med = self._trailing_median()
+            mean = sum(losses) / len(losses)
+            if med is not None and mean > self.cfg.spike_factor * med:
+                return (
+                    f"loss spike: window mean {mean:.4g} > "
+                    f"{self.cfg.spike_factor}x trailing median {med:.4g}"
+                )
+        return None
+
+    def healthy_loss(self, value: float) -> bool:
+        """Single-value health check for off-boundary decisions (the
+        trainer's checkpoint gate): same criteria as the window check —
+        non-finite always unhealthy, spike-mode also rejects finite
+        divergence, since a verified-but-diverged checkpoint would become
+        the rollback target and trap the ladder."""
+        if not self.cfg.enabled:
+            return True
+        return self._classify([value]) is None
+
+    # -- ladder ------------------------------------------------------------
+    def check_window(self, step: int, losses: list[float]) -> GuardDecision:
+        """Judge one log window. The caller (trainer) executes the action
+        and emits the telemetry; the guard only decides and keeps score."""
+        if not self.cfg.enabled or not losses:
+            return GuardDecision("ok")
+        reason = self._classify(losses)
+        if reason is None:
+            self._consecutive_bad = 0
+            self._means.append(sum(losses) / len(losses))
+            return GuardDecision("ok")
+        self._consecutive_bad += 1
+        if (
+            self.cfg.skip_nonfinite_updates
+            and reason == "non-finite loss"
+            and self._consecutive_bad <= self.cfg.max_consecutive_skips
+        ):
+            return GuardDecision(
+                "tolerate",
+                f"{reason} @ step {step}; updates skipped device-side "
+                f"({self._consecutive_bad}/{self.cfg.max_consecutive_skips} "
+                "windows tolerated)",
+            )
+        if not self.can_rollback:
+            return GuardDecision("warn", f"{reason} @ step {step}; no "
+                                 "checkpoint to roll back to")
+        if self.rollbacks_done >= self.cfg.max_rollbacks:
+            return GuardDecision(
+                "abort",
+                f"{reason} @ step {step} after {self.rollbacks_done} "
+                "rollbacks — policy ladder exhausted",
+            )
+        return GuardDecision("rollback", f"{reason} @ step {step}")
+
+    def note_rollback(self) -> None:
+        """The trainer completed a rollback this guard ordered."""
+        self.rollbacks_done += 1
+        self._consecutive_bad = 0
+
+    def note_rollback_failed(self) -> None:
+        """The trainer could NOT execute an ordered rollback (no intact
+        checkpoint). Burns a ladder rung anyway: without this, a run whose
+        checkpoints are all gone re-decides 'rollback' at every boundary
+        forever and the abort rung is unreachable — it would train on NaN
+        params to completion."""
+        self.rollbacks_done += 1

@@ -1,0 +1,106 @@
+"""Position-preserving retry for re-openable streams.
+
+HuggingFace streaming iterators die on transient network faults and cannot
+be resumed in place — but they CAN be re-opened with ``ds.skip(n)``. The
+wrapper here exploits that: it tracks the absolute index of the next item
+to consume, and on failure re-invokes a ``factory(index)`` that must return
+a fresh iterator starting at exactly that index. Consumers therefore see
+one uninterrupted, exactly-once item sequence across any number of
+underlying re-opens — which is what keeps a healed training run bit-exact
+with an unfaulted one (the chaos tests assert this parity).
+
+Backoff is exponential with jitter and bounded attempts; ``sleep`` and
+``rng`` are injectable so tests run in microseconds.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+from typing import Any, Callable, Iterator
+
+from dtc_tpu.resilience.errors import DataStreamError
+
+
+def backoff_schedule(
+    attempt: int, base_s: float, max_s: float, jitter: float,
+    rng: random.Random | None = None,
+) -> float:
+    """Delay before retry ``attempt`` (1-based): ``base * 2**(attempt-1)``
+    capped at ``max_s``, +/- ``jitter`` fraction of itself."""
+    delay = min(base_s * (2.0 ** (attempt - 1)), max_s)
+    if jitter > 0:
+        r = rng if rng is not None else random
+        delay *= 1.0 + jitter * (2.0 * r.random() - 1.0)
+    return max(delay, 0.0)
+
+
+def resilient_iterator(
+    factory: Callable[[int], Iterator[Any]],
+    *,
+    start_index: int = 0,
+    max_attempts: int = 5,
+    backoff_s: float = 1.0,
+    backoff_max_s: float = 30.0,
+    jitter: float = 0.1,
+    transient: tuple[type[BaseException], ...] = (Exception,),
+    on_event: Callable[..., None] | None = None,
+    sleep: Callable[[float], None] = time.sleep,
+    rng: random.Random | None = None,
+    cancel: Any = None,
+) -> Iterator[Any]:
+    """Yield ``factory(start_index)``'s items; self-heal on transient faults.
+
+    ``factory(index)`` must return an iterator whose first item is the
+    stream's absolute item ``index`` — re-opens never replay or drop items.
+    The consecutive-failure counter resets after every successful yield, so
+    ``max_attempts`` bounds attempts per fault, not per stream lifetime.
+    ``on_event(etype, **fields)`` (a :class:`RecoveryBus` post) receives one
+    ``recovery``/``stream_retry`` record per re-open.
+
+    Raises :class:`DataStreamError` (with the last fault as ``__cause__``)
+    once ``max_attempts`` consecutive attempts fail. ``StopIteration`` from
+    the source is genuine end-of-stream and is never retried.
+
+    ``cancel`` (a ``threading.Event``) makes the backoff interruptible: a
+    consumer tearing the pipeline down (trainer rollback) sets it, and the
+    wrapper ends the stream immediately instead of sleeping out up to
+    ``backoff_max_s`` as an orphan that would re-open the source and post
+    stale retry events.
+    """
+    index = start_index
+    attempts = 0
+    it = None
+    while True:
+        try:
+            if it is None:
+                it = factory(index)
+            item = next(it)
+        except StopIteration:
+            return
+        except transient as e:
+            attempts += 1
+            if attempts >= max_attempts:
+                raise DataStreamError(
+                    f"data stream failed {attempts} consecutive attempts at "
+                    f"item {index}; giving up ({type(e).__name__}: {e})"
+                ) from e
+            if cancel is not None and cancel.is_set():
+                return  # pipeline torn down: no event, no re-open
+            delay = backoff_schedule(attempts, backoff_s, backoff_max_s, jitter, rng)
+            if on_event is not None:
+                on_event(
+                    "recovery", action="stream_retry", index=index,
+                    attempt=attempts, backoff_s=round(delay, 3),
+                    error=f"{type(e).__name__}: {e}",
+                )
+            if cancel is not None:
+                if cancel.wait(delay):
+                    return  # cancelled mid-backoff
+            else:
+                sleep(delay)
+            it = None  # re-open at the exact failure position
+            continue
+        attempts = 0
+        index += 1
+        yield item

@@ -13,7 +13,19 @@ import optax
 from dtc_tpu.config.schema import OptimConfig
 
 
-def create_optimizer(cfg: OptimConfig, total_steps: int = 0) -> optax.GradientTransformation:
+def create_optimizer(
+    cfg: OptimConfig,
+    total_steps: int = 0,
+    *,
+    skip_nonfinite: bool = False,
+    max_consecutive_skips: int = 10,
+) -> optax.GradientTransformation:
+    """``skip_nonfinite`` wraps the whole chain in
+    ``optax.apply_if_finite``: a step whose updates contain NaN/inf leaves
+    params and optimizer state untouched — the anomaly guard's cheapest
+    policy rung, applied device-side with no extra host sync. NOTE: the
+    wrapper changes the optimizer-state pytree, so checkpoints do not carry
+    across toggling it (resilience.guard.skip_nonfinite_updates)."""
     if cfg.schedule == "constant":
         lr = cfg.lr
     elif cfg.schedule == "warmup_cosine":
@@ -33,7 +45,10 @@ def create_optimizer(cfg: OptimConfig, total_steps: int = 0) -> optax.GradientTr
         if cfg.grad_clip > 0
         else optax.identity()
     )
-    return optax.chain(
+    tx = optax.chain(
         clip,
         optax.adamw(learning_rate=lr, b1=cfg.b1, b2=cfg.b2, weight_decay=cfg.weight_decay),
     )
+    if skip_nonfinite:
+        tx = optax.apply_if_finite(tx, max_consecutive_errors=max_consecutive_skips)
+    return tx

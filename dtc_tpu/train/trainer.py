@@ -21,6 +21,7 @@ windows, and multi-host feeding.
 from __future__ import annotations
 
 import os
+import threading
 import time
 from dataclasses import dataclass, field
 from typing import Any, Iterator
@@ -92,6 +93,9 @@ def make_host_iterator(
     seed_offset: int = 0,
     stream_position: dict | None = None,
     history: int = 64,
+    chaos=None,
+    on_recovery=None,
+    cancel=None,
 ) -> Iterator[np.ndarray]:
     """(batch, seq_len+1) token batches; per-process share in multi-host runs.
 
@@ -100,7 +104,12 @@ def make_host_iterator(
     TokenPacker position — documents skipped at the source, buffer
     restored). ``skip_batches`` on fineweb is the drain-loop FALLBACK for
     checkpoints that predate position sidecars. ``seed_offset`` selects a
-    disjoint synthetic stream (used by eval)."""
+    disjoint synthetic stream (used by eval).
+
+    The fineweb stream self-heals transient faults per
+    ``train_cfg.resilience.stream_retry`` (position-preserving re-open with
+    backoff); ``chaos`` threads the fault injector into the document source
+    and ``on_recovery`` (a RecoveryBus post) receives retry records."""
     seq = model_cfg.max_seq_len + 1
     batch = _per_process_batch(train_cfg)
     if train_cfg.dataset == "synthetic":
@@ -118,6 +127,10 @@ def make_host_iterator(
         process_count=jax.process_count(),
         position=stream_position,
         history=history,
+        retry=train_cfg.resilience.stream_retry,
+        chaos=chaos,
+        on_recovery=on_recovery,
+        cancel=cancel,
     )
     for _ in range(skip_batches):
         next(it)
@@ -176,7 +189,12 @@ def init_state(
         lambda s: NamedSharding(mesh, s), specs, is_leaf=lambda x: isinstance(x, P)
     )
     params = jax.device_put(params, shardings)
-    tx = create_optimizer(opt_cfg, total_steps=train_cfg.steps)
+    guard_cfg = train_cfg.resilience.guard
+    tx = create_optimizer(
+        opt_cfg, total_steps=train_cfg.steps,
+        skip_nonfinite=guard_cfg.skip_nonfinite_updates,
+        max_consecutive_skips=guard_cfg.max_consecutive_skips,
+    )
     # Eager tx.init on sharded params: zeros_like follows input sharding, so
     # the optimizer state lands correctly sharded without an _infer pass
     # (cf. /root/reference/train/train.py:44-52).
@@ -225,7 +243,9 @@ def _train(
     host_iterator: Iterator[np.ndarray] | None = None,
     rules=DEFAULT_RULES,
 ) -> TrainResult:
-    maybe_initialize_distributed(train_cfg.multihost)
+    maybe_initialize_distributed(
+        train_cfg.multihost, train_cfg.coordinator_timeout_s
+    )
     num_devices = jax.device_count()
     mesh = mesh_from_config(
         train_cfg.parallel, train_cfg.mesh, n_layers=model_cfg.n_layers
@@ -265,6 +285,35 @@ def _train(
 
     model = GPT(model_cfg)
 
+    # ------ resilience subsystem (SURVEY §5 failure-detection row) ------
+    # Bus first: recovery actions fire from threads and layers that have no
+    # telemetry handle (stream retry on the prefetch worker, checkpoint
+    # fallback inside CheckpointManager); the trainer drains the bus into
+    # the event stream at step/log boundaries.
+    from dtc_tpu.resilience import (
+        AnomalyAbort,
+        AnomalyGuard,
+        ChaosInjector,
+        RecoveryBus,
+        StepWatchdog,
+        WatchdogTimeout,
+    )
+
+    res_cfg = train_cfg.resilience
+    bus = RecoveryBus()
+    chaos = ChaosInjector(res_cfg.chaos, bus) if res_cfg.chaos.enabled else None
+    if chaos is not None and (
+        res_cfg.chaos.data_error_at_doc or res_cfg.chaos.data_stall_at_doc
+    ) and not (train_cfg.dataset == "fineweb" and host_iterator is None):
+        # The data-plane hooks live in the fineweb document source; on
+        # synthetic (or a caller-provided iterator) they would silently
+        # never fire — and a chaos drill that runs nothing reads as a pass.
+        print(
+            "[dtc_tpu] WARNING: chaos data faults (data_error_at_doc/"
+            "data_stall_at_doc) only fire on dataset: fineweb; this run "
+            "will not inject them"
+        )
+
     with mesh, nn.logical_axis_rules(rules):
         state = init_state(model, model_cfg, train_cfg, opt_cfg, mesh, rules)
 
@@ -277,15 +326,51 @@ def _train(
             ckpt_dir = train_cfg.checkpoint_dir or os.path.join(
                 train_cfg.output_dir, "checkpoints"
             )
-            ckpt = CheckpointManager(ckpt_dir)
-            if train_cfg.resume and ckpt.latest_step() is not None:
-                state = ckpt.restore(state)
+            ckpt = CheckpointManager(
+                ckpt_dir, verify=res_cfg.verify_checkpoints, on_event=bus.post
+            )
+            # Gate on EXISTENCE only (all_steps) — restore_latest does the
+            # single integrity verification; a latest_step() here would
+            # sha256 the newest multi-GB step a second time back to back.
+            if train_cfg.resume and ckpt.all_steps():
+                # Verified resume: restore the newest INTACT step (corrupt
+                # or partial checkpoints are skipped with a recovery event).
                 # Checkpoint labels are LOOP steps. state.step also counts
                 # warmup updates, so it reads warmup_steps ahead — using it
                 # here would skip real work on resume.
-                start_step = ckpt.latest_step()
-                if lead:
-                    print(f"[dtc_tpu] resumed from checkpoint step {start_step}")
+                try:
+                    state, start_step = ckpt.restore_latest(state)
+                    if lead:
+                        print(
+                            f"[dtc_tpu] resumed from checkpoint step {start_step}"
+                        )
+                except FileNotFoundError as e:
+                    # Every candidate step is corrupt. Silently starting
+                    # fresh would discard real progress (and would trip the
+                    # log.csv clobber guard anyway) — fail with the way out.
+                    raise RuntimeError(
+                        "resume requested but no checkpoint could be "
+                        f"restored from {ckpt_dir}. Causes range from real "
+                        "corruption to a model/optimizer config that no "
+                        "longer matches the saved state (see the chained "
+                        "error). Inspect the checkpoint dir, revert config "
+                        "changes, or set resume: false (plus overwrite: true "
+                        "if output_dir holds a previous log.csv) to "
+                        "deliberately start fresh"
+                    ) from e
+
+        # Anomaly guard: rollback needs a checkpoint manager AND a stream
+        # the trainer can rebuild (a caller-provided host_iterator cannot
+        # be re-positioned).
+        guard = (
+            AnomalyGuard(
+                res_cfg.guard,
+                can_rollback=(ckpt is not None and host_iterator is None),
+            )
+            if res_cfg.guard.enabled
+            else None
+        )
+        wd = StepWatchdog(res_cfg.watchdog) if res_cfg.watchdog.enabled else None
 
         train_step = create_train_step(
             mesh, model=model, num_microbatches=train_cfg.pp_microbatches,
@@ -308,7 +393,14 @@ def _train(
             fineweb and train_cfg.eval_every > 0
         ) else 0
         holdout_every = train_cfg.eval_holdout_every
-        skip = train_cfg.warmup_steps + start_step if start_step > 0 else 0
+        proc = jax.process_index()
+        # History must out-span prefetch look-ahead AND the holdout's
+        # eager head consumption, or early checkpoints can't find their
+        # position (review finding, round 4).
+        span = (holdout_n - 1) * holdout_every + 1 if holdout_n else 0
+        hist = span + 64
+
+        host_it = None             # host-side batch iterator
         stream_obj = None          # FinewebStream (position bookkeeping)
         eval_host_batches = None   # held-out fineweb eval batches
         delivered = 0              # batches handed to warmup+train so far
@@ -317,36 +409,59 @@ def _train(
         # remainder of it relative to a resumed stream's position.
         train_drops: set[int] = set()
         stream_base = 0  # absolute yield index where this run's stream starts
-        if host_iterator is not None:
-            host_it = host_iterator
-            for _ in range(skip):
-                next(host_it)
-        elif not fineweb:
-            host_it = make_host_iterator(train_cfg, model_cfg, skip_batches=skip)
-        else:
-            proc = jax.process_index()
-            # History must out-span prefetch look-ahead AND the holdout's
-            # eager head consumption, or early checkpoints can't find their
-            # position (review finding, round 4).
-            span = (holdout_n - 1) * holdout_every + 1 if holdout_n else 0
-            hist = span + 64
+        stream_start_step = start_step  # loop step the stream is positioned at
+        # Per-stream-generation teardown signal: set on rollback so a
+        # prefetch worker parked in the retry backoff exits immediately
+        # instead of out-sleeping close(), re-opening the dead stream, and
+        # posting stale retry events through the captured bus.
+        stream_cancel = threading.Event()
+
+        def build_data(resume_from: int) -> None:
+            """(Re)position the host stream as of checkpoint step
+            ``resume_from`` (0 = stream head). Called once at startup and
+            again on every guard rollback — a rollback IS a resume, minus
+            the process restart, so both paths share this code."""
+            nonlocal host_it, stream_obj, delivered, train_drops
+            nonlocal stream_base, eval_host_batches, stream_start_step
+            nonlocal stream_cancel
+            stream_cancel = threading.Event()  # fresh generation
+            stream_start_step = resume_from
+            delivered = 0
+            train_drops = set()
+            stream_base = 0
+            stream_obj = None
+            skip = (
+                train_cfg.warmup_steps + resume_from if resume_from > 0 else 0
+            )
+            if host_iterator is not None:
+                host_it = host_iterator
+                for _ in range(skip):
+                    next(host_it)
+                return
+            if not fineweb:
+                host_it = make_host_iterator(
+                    train_cfg, model_cfg, skip_batches=skip
+                )
+                return
             sidecar = (
-                ckpt.load_stream(start_step, proc)
-                if (ckpt and start_step > 0) else None
+                ckpt.load_stream(resume_from, proc)
+                if (ckpt and resume_from > 0) else None
             )
             if sidecar is not None:
                 stream_obj = make_host_iterator(
                     train_cfg, model_cfg,
                     stream_position=sidecar["position"], history=hist,
+                    chaos=chaos, on_recovery=bus.post, cancel=stream_cancel,
                 )
                 host_it = stream_obj
                 stream_base = sidecar["stream_index"]
                 if holdout_n:
                     # Eval batches were diverted from the stream HEAD; any
                     # diverted index past the resume point must still be
-                    # withheld from training. The eval set itself is
-                    # restored from its sidecar (or, for pre-sidecar
-                    # checkpoints, rebuilt from a fresh head stream).
+                    # withheld from training. The eval set itself is kept
+                    # from before the rollback, restored from its sidecar,
+                    # or (pre-sidecar checkpoints) rebuilt from a fresh
+                    # head stream.
                     train_drops = {
                         d - sidecar["stream_index"]
                         for d in diverted_indices(holdout_every, holdout_n)
@@ -354,25 +469,33 @@ def _train(
                     }
                     if train_drops:
                         host_it = _drop_yields(host_it, train_drops)
-                    eval_host_batches = ckpt.load_eval_set(proc)
+                    if eval_host_batches is None:
+                        eval_host_batches = ckpt.load_eval_set(proc)
                     if eval_host_batches is None:
                         head = make_host_iterator(train_cfg, model_cfg)
                         _, eval_host_batches = divert_holdout(
                             head, holdout_every, holdout_n
                         )
             else:
-                stream_obj = make_host_iterator(train_cfg, model_cfg, history=hist)
+                stream_obj = make_host_iterator(
+                    train_cfg, model_cfg, history=hist,
+                    chaos=chaos, on_recovery=bus.post, cancel=stream_cancel,
+                )
                 host_it = stream_obj
                 if holdout_n:
                     train_drops = diverted_indices(holdout_every, holdout_n)
-                    host_it, eval_host_batches = divert_holdout(
+                    host_it, diverted = divert_holdout(
                         host_it, holdout_every, holdout_n
                     )
-                    if ckpt:
-                        ckpt.save_eval_set(eval_host_batches, proc)
+                    if eval_host_batches is None:
+                        eval_host_batches = diverted
+                        if ckpt:
+                            ckpt.save_eval_set(eval_host_batches, proc)
                 for _ in range(skip):  # pre-sidecar fallback: drain
                     next(host_it)
                 delivered = skip
+
+        build_data(start_step)
         data_it = ShardedPrefetchIterator(
             host_it, mesh, batch_spec(rules), queue_size=train_cfg.prefetch
         )
@@ -383,7 +506,7 @@ def _train(
             may have pulled a few batches further ahead)."""
             if stream_obj is None:
                 return None
-            n = delivered + (step - start_step)
+            n = delivered + (step - stream_start_step)
             idx = stream_index_for(n, train_drops)  # relative to THIS stream
             return {
                 "position": stream_obj.position_after(idx),
@@ -397,6 +520,9 @@ def _train(
         key = jax.random.key(train_cfg.seed, impl=train_cfg.prng_impl)
 
         result = TrainResult(state=state, mesh=mesh)
+        # Step the result lists start after (losses[0] is result_base+1's);
+        # only a rollback below the resume point ever moves it.
+        result_base = start_step
         log_path = os.path.join(train_cfg.output_dir, "log.csv")
         clobber = bool(
             train_cfg.output_dir
@@ -495,6 +621,77 @@ def _train(
                 tele.close()
                 raise
 
+        def do_rollback(
+            cur_step: int,
+            reason: str,
+            window_losses: list[float],
+            window_rows: list[tuple[int, float]],
+        ) -> int | None:
+            """Guard ladder rung 2: restore the newest VERIFIED checkpoint,
+            re-seek the data stream via its position sidecar, and return
+            the restored step (the loop resumes from there). None when no
+            intact checkpoint exists yet (the guard then only warns).
+
+            ``window_losses``/``window_rows`` are the detection window's
+            fetched-but-uncommitted entries: the prefix at or before the
+            restored step is COMMITTED (those steps will not be replayed —
+            e.g. checkpoint at 10 inside a 9..16 window rolling back to 10
+            must still log 9 and 10), the poisoned suffix is discarded and
+            replayed. Steps already logged between the restored step and
+            the anomaly re-log on replay (CSV gets both rows; the JSONL
+            stream is the durable, annotated history)."""
+            nonlocal state, data_it, result_base
+            if ckpt is None:
+                return None
+            try:
+                state_rb, target = ckpt.restore_latest(state)
+            except FileNotFoundError:
+                return None  # nothing intact yet: the guard only warns
+            # Re-commit stray scalar leaves to the mesh so the restored
+            # state's input signature matches the compiled step executable
+            # exactly — a rollback must not trigger a recompile.
+            state = canonicalize_state_placement(state_rb, mesh)
+            stream_cancel.set()  # wake any retry backoff: the stream is dead
+            data_it.close()  # stop the old prefetch worker before rebuilding
+            build_data(target)
+            data_it = ShardedPrefetchIterator(
+                host_it, mesh, batch_spec(rules), queue_size=train_cfg.prefetch
+            )
+            guard.note_rollback()
+            for (s, el), lo in zip(window_rows, window_losses):
+                if s <= target:  # not replayed: commit now or lose it
+                    result.losses.append(lo)
+                    tele.emit_train_row(s, el, lo)
+            # Drop the poisoned suffix from the in-memory results; the
+            # replayed steps re-append (and re-log) from the restored step.
+            # result_base is the step the lists currently start AFTER —
+            # start_step originally, but a rollback below the resume point
+            # (all post-resume checkpoints rejected) moves it down, and a
+            # later truncation must count from where the lists now begin.
+            keep = max(target - result_base, 0)
+            del result.losses[keep:]
+            del result.elapsed_times[keep:]
+            result.eval_losses[:] = [
+                e for e in result.eval_losses if e[0] <= target
+            ]
+            result_base = min(result_base, target)
+            tele.on_recovery(
+                cur_step, action="rollback", to_step=target, reason=reason,
+                rollbacks=guard.rollbacks_done,
+            )
+            tele.drain_recovery_bus(bus, cur_step)
+            # The restore's host transfers may compile tiny executables —
+            # attribute them here, not as a train-step recompile.
+            tele.record_aux_compile(cur_step, "rollback")
+            tele.flush()
+            if lead:
+                print(
+                    f"[dtc_tpu] ROLLBACK: {reason} — restored verified "
+                    f"checkpoint step {target}, stream re-seeked "
+                    f"({guard.rollbacks_done}/{res_cfg.guard.max_rollbacks})"
+                )
+            return target
+
         def run_eval(step: int) -> float:
             """Returns the wall-clock the eval pass took, so the caller can
             keep it out of the cumulative training elapsed_time."""
@@ -532,7 +729,6 @@ def _train(
         # proved the mechanism end-to-end on the real chip; this moves the
         # guarantee into every trainer run).
         import signal
-        import threading
 
         stop_requested = {"flag": False}
         prev_handler = None
@@ -595,14 +791,41 @@ def _train(
 
             tokens_per_step = train_cfg.batch * model_cfg.max_seq_len
 
-            for step in range(start_step + 1, train_cfg.steps + 1):
+            if wd is not None:
+                # The hard-timeout monitor aborts via interrupt_main — off
+                # the main thread that lands in an unrelated thread and the
+                # clean WatchdogTimeout path never fires (same reason the
+                # SIGTERM handler above is main-thread-gated). Flag-only
+                # observation still works from any thread.
+                if in_main_thread:
+                    wd.start()
+                elif res_cfg.watchdog.hard_timeout_s > 0:
+                    print(
+                        "[dtc_tpu] WARNING: watchdog hard_timeout_s disabled "
+                        "(trainer not on the main thread); flagging only"
+                    )
+            # while (not for): a guard rollback moves the step pointer
+            # BACKWARD to the restored checkpoint and the loop replays.
+            step = start_step
+            while step < train_cfg.steps:
+                step += 1
                 tele.on_step_start(step)  # profiler window + step clock
+                if wd is not None:
+                    wd.arm(step)  # hard-timeout cover for data_wait+step
                 with tele.clock.phase("data_wait"):
                     x, y = next(data_it)
                 with tele.clock.phase("dispatch"):
                     state, loss = train_step(
                         state, Batch(x=x, y=y), jax.random.fold_in(key, step)
                     )
+                if chaos is not None:
+                    poisoned, loss = chaos.maybe_poison(step, state, loss)
+                    if poisoned is not state:
+                        state = poisoned
+                        # The poison's eager per-leaf ops compile tiny
+                        # executables — attribute them, don't let the next
+                        # on_step_end flag a phantom train-step recompile.
+                        tele.record_aux_compile(step, "chaos_poison")
                 device_losses.append(loss)
                 if sync_every_step:
                     with tele.clock.phase("block"):
@@ -610,20 +833,96 @@ def _train(
                 now = time.perf_counter()
                 result.elapsed_times.append(now - start_time)
                 pending_rows.append((step, now - start_time))
-                tele.on_step_end(
+                breakdown = tele.on_step_end(
                     step, elapsed_s=now - start_time, synced=bool(sync_every_step)
                 )
+                if wd is not None:
+                    flag = wd.observe(step, breakdown["step_time_s"])
+                    if flag is not None:
+                        tele.on_hung_step(**flag)
+                        if res_cfg.watchdog.profile_on_flag:
+                            tele.arm_profile_window(step + 1)
                 window_steps += 1
 
+                if chaos is not None and chaos.should_preempt(step):
+                    if in_main_thread:
+                        # Simulated preemption: a REAL signal through the
+                        # real handler (delivered synchronously here).
+                        os.kill(os.getpid(), signal.SIGTERM)
+                    else:
+                        # No graceful handler was installed off the main
+                        # thread — a raw SIGTERM would hit the default
+                        # disposition and kill the process. Emulate the
+                        # handler's effect instead.
+                        stop_requested["flag"] = True
                 stopping = stop_requested["flag"]
                 if stopping and lead:
                     print(f"[dtc_tpu] stopping at step {step} (SIGTERM)")
 
                 if step % train_cfg.log_every == 0 or step == train_cfg.steps or stopping:
+                    # Re-arm the hard timeout for the boundary's loss
+                    # fetch: with per-step sync OFF, dispatch is async and
+                    # a wedged collective actually blocks HERE — not inside
+                    # the step call the per-step arm covered. The healthy
+                    # wait is the WHOLE dispatched window, so the budget
+                    # scales by log_every. Disarmed once the fetch+guard
+                    # section completes: eval and verified checkpoint saves
+                    # scale with model size, not step time, and must not be
+                    # judged by a step-scale budget.
+                    if wd is not None:
+                        wd.arm(
+                            step,
+                            budget_s=res_cfg.watchdog.hard_timeout_s
+                            * max(train_cfg.log_every, 1),
+                        )
                     # One stacked transfer, not len(window) scalar fetches — a
                     # per-array fetch costs a full RTT on tunneled platforms.
                     losses = [float(v) for v in jax.device_get(jnp.stack(device_losses))]
                     now = time.perf_counter()  # after the device sync
+                    # Anomaly guard rides the losses ALREADY fetched for
+                    # logging — zero additional per-step syncs.
+                    if guard is not None:
+                        decision = guard.check_window(step, losses)
+                        if decision.anomalous:
+                            tele.on_anomaly(
+                                step, reason=decision.reason,
+                                action=decision.action,
+                            )
+                            if lead:
+                                print(
+                                    f"[dtc_tpu] ANOMALY: {decision.reason} "
+                                    f"-> {decision.action}"
+                                )
+                        if decision.action == "abort":
+                            tele.on_recovery(
+                                step, action="abort", reason=decision.reason
+                            )
+                            tele.drain_recovery_bus(bus, step)
+                            raise AnomalyAbort(decision.reason)
+                        if decision.action == "rollback":
+                            target = do_rollback(
+                                step, decision.reason, losses, pending_rows
+                            )
+                            if target is not None:
+                                # Discard the poisoned window wholesale —
+                                # no rows logged, no eval, no checkpoint —
+                                # and replay from the restored step.
+                                step = target
+                                device_losses, pending_rows = [], []
+                                window_start = time.perf_counter()
+                                window_steps = 0
+                                if wd is not None:
+                                    wd.disarm()  # continue skips loop bottom
+                                continue
+                            # No intact checkpoint to restore: burn a
+                            # ladder rung anyway so persistent anomalies
+                            # still reach the abort rung instead of
+                            # re-deciding "rollback" forever.
+                            guard.note_rollback_failed()
+                            tele.on_recovery(
+                                step, action="rollback_failed",
+                                reason=decision.reason,
+                            )
                     # With per-step sync OFF, rows are dispatch-stamped:
                     # re-stamp the window's last row post-fetch so every
                     # log_every-th elapsed_time (and the final total) reflects
@@ -647,6 +946,9 @@ def _train(
                         tokens_per_sec=tokens_per_step / avg_step,
                         mfu=u,
                     )
+                    # Surface recovery actions posted from other threads
+                    # (stream retries, checkpoint fallbacks) at the boundary.
+                    tele.drain_recovery_bus(bus, step)
                     tele.flush()
                     if lead:
                         msg = (
@@ -664,6 +966,8 @@ def _train(
                     tele.record_aux_compile(step, "log_boundary")
                     window_start = time.perf_counter()
                     window_steps = 0
+                    if wd is not None:
+                        wd.disarm()  # before model-size-scale eval/save work
 
                 if eval_fn is not None and (
                     step % train_cfg.eval_every == 0 or step == train_cfg.steps
@@ -679,22 +983,72 @@ def _train(
                     window_steps = 0
 
                 if ckpt and (step % train_cfg.checkpoint_every == 0 or stopping):
-                    tele.registry.counter("checkpoints").inc()
-                    ckpt.save(step, state)
-                    sidecar_out = stream_position_sidecar(step)
-                    if sidecar_out is not None:
-                        # Per-process: each pod host's stream position differs.
-                        ckpt.save_stream(step, sidecar_out, jax.process_index())
+                    # Health-gate the save: between anomaly onset and the
+                    # next log boundary the state may already be poisoned
+                    # (NaN, or a finite spike in spike mode), and a
+                    # poisoned-but-bit-intact checkpoint would become the
+                    # rollback target (restoring it forever until the
+                    # ladder aborts). One scalar fetch per checkpoint —
+                    # noise next to the Orbax write it gates.
+                    if guard is not None and not guard.healthy_loss(
+                        float(jax.device_get(loss))
+                    ):
+                        tele.on_recovery(
+                            step, action="skip_checkpoint",
+                            reason="unhealthy loss at save point",
+                        )
+                        if lead:
+                            print(
+                                f"[dtc_tpu] skipping checkpoint at step {step}: "
+                                "unhealthy loss at save point (see the "
+                                "telemetry recovery event)"
+                            )
+                    else:
+                        tele.registry.counter("checkpoints").inc()
+                        ckpt.save(step, state)  # waits + writes integrity manifest
+                        sidecar_out = stream_position_sidecar(step)
+                        if sidecar_out is not None:
+                            # Per-process: each pod host's stream position
+                            # differs.
+                            ckpt.save_stream(
+                                step, sidecar_out, jax.process_index()
+                            )
+                        if chaos is not None:
+                            # Damage AFTER the verified write: later reads
+                            # must detect the mismatch and fall back.
+                            chaos.maybe_corrupt_checkpoint(
+                                step, ckpt.step_dir(step)
+                            )
                     tele.record_aux_compile(step, "checkpoint")
 
+                if wd is not None:
+                    wd.disarm()  # end of boundary-iteration blocking work
                 if stopping:
                     break
+        except KeyboardInterrupt as e:
+            # The watchdog's hard-timeout monitor interrupts the main
+            # thread; surface it as the typed abort, telemetry closed.
+            tele.close()
+            if wd is not None and wd.timed_out:
+                raise WatchdogTimeout(
+                    f"step exceeded hard timeout "
+                    f"({res_cfg.watchdog.hard_timeout_s}s)"
+                ) from e
+            raise
         except BaseException:
             # A crashed run still keeps its flushed JSONL prefix — same
             # crash-survival contract as the incremental CSV.
             tele.close()
             raise
         finally:
+            if wd is not None:
+                wd.stop()
+            # Stop the prefetch worker (rollback may have already swapped
+            # it once; close is idempotent) so no thread outlives the run.
+            try:
+                data_it.close()
+            except Exception:
+                pass
             # Restore even when the loop raises: a stale handler would
             # silently swallow a later (real) SIGTERM.
             if in_main_thread:
@@ -706,6 +1060,7 @@ def _train(
             {k: int(v) for k, v in mesh.shape.items()},
             train_cfg.parallel, train_cfg.pp_microbatches,
         )
+        tele.drain_recovery_bus(bus, step)  # tail actions (retry, fallback)
         tele.on_run_end(
             total_time_s=round(total, 4),
             steps=timed_steps,

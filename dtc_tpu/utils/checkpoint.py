@@ -1,42 +1,229 @@
-"""Sharding-aware checkpoint / resume via Orbax.
+"""Sharding-aware, integrity-verified checkpoint / resume via Orbax.
 
 The reference never persists anything but the CSV log (SURVEY.md §5
 "Checkpoint / resume: absent"). Orbax restores arrays directly into their
 NamedShardings, so resume works across mesh shapes as long as the logical
 param tree matches.
+
+Integrity (CheckFreq-style verified checkpoints): every ``save`` waits for
+the async write to land, then records a checksum manifest
+(``manifest_<step>.json``: per-file size + sha256) next to the step.
+``latest_step``/``restore`` re-verify against the manifest and silently
+fall back to the newest INTACT earlier step when the latest is corrupt or
+partial — a preempted half-written checkpoint (or bit rot) costs a few
+steps of progress instead of the whole run. All JSON/npz sidecars are
+written atomically (tmp + ``os.replace``) so a preemption mid-write can
+never leave a truncated file that poisons the *next* resume.
 """
 
 from __future__ import annotations
 
+import glob
+import hashlib
+import json
 import os
-from typing import Any
+from typing import Any, Callable
 
 import jax
 import numpy as np
 
 PyTree = Any
 
+MANIFEST_SKIP = {".tmp"}  # our own atomic-write temp suffix
+
+
+def _atomic_write_bytes(path: str, data: bytes) -> None:
+    """tmp + fsync + os.replace: readers see the old file or the new file,
+    never a truncated one — even across a preemption mid-write."""
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(data)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def _atomic_write_json(path: str, obj: Any) -> None:
+    _atomic_write_bytes(path, json.dumps(obj).encode())
+
+
+def _sha256_file(path: str, chunk: int = 1 << 20) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        while True:
+            block = f.read(chunk)
+            if not block:
+                break
+            h.update(block)
+    return h.hexdigest()
+
 
 class CheckpointManager:
-    def __init__(self, directory: str):
+    """Orbax checkpoints + position sidecars + integrity manifests.
+
+    ``on_event(etype, **fields)`` (typically a
+    :class:`dtc_tpu.resilience.events.RecoveryBus` post) receives one
+    ``recovery``/``ckpt_fallback`` record whenever verification rejects a
+    step, so silent fallbacks still land in telemetry. ``verify=False``
+    skips manifest writing/checking (and the save-side wait it requires).
+
+    Known multi-host cost: on resume every process hashes the newest step
+    during its own restore_latest (N redundant read passes over shared
+    storage). Lead-verify + broadcast (the clobber-guard pattern in the
+    trainer) would cut it to one pass, but needs cross-host agreement on
+    the chosen step through the fallback path — deferred until multi-host
+    restore paths are exercisable in tests; set ``verify=False``
+    (``resilience.verify_checkpoints``) if resume-time hashing dominates.
+    """
+
+    def __init__(
+        self,
+        directory: str,
+        *,
+        verify: bool = True,
+        on_event: Callable[..., None] | None = None,
+    ):
         import orbax.checkpoint as ocp
 
         self._dir = os.path.abspath(directory)
         os.makedirs(self._dir, exist_ok=True)
+        self.verify = verify
+        self._on_event = on_event
+        # Steps that already failed verification: skip re-hashing them (and
+        # re-warning) on every later latest_step/restore call — a corrupt
+        # step stays corrupt unless re-saved, which clears its entry.
+        # Passes are deliberately NOT cached: bit rot between two reads
+        # must still be caught, so callers that only need existence should
+        # gate on all_steps() and leave the one full verification to
+        # restore_latest (as the trainer's resume path does).
+        self._rejected: set[int] = set()
         self._mgr = ocp.CheckpointManager(
             self._dir, options=ocp.CheckpointManagerOptions(max_to_keep=3)
         )
 
+    # ---- paths -----------------------------------------------------------
+    def step_dir(self, step: int) -> str:
+        return os.path.join(self._dir, str(step))
+
+    def _manifest_path(self, step: int) -> str:
+        return os.path.join(self._dir, f"manifest_{step}.json")
+
+    # ---- save / verify ---------------------------------------------------
     def save(self, step: int, state: PyTree) -> None:
         import orbax.checkpoint as ocp
 
+        if step in self._mgr.all_steps():
+            # Replaying past a rollback (or a resume that fell back below
+            # the newest step) re-visits steps with stale — possibly
+            # corrupt — checkpoints on disk. The fresh save supersedes;
+            # the old manifest goes too, or a verify=False re-save would
+            # leave a mismatched manifest that damns the good new bytes
+            # the next time verification is on.
+            self._mgr.delete(step)
+            try:
+                os.remove(self._manifest_path(step))
+            except FileNotFoundError:
+                pass
+        self._rejected.discard(step)
         self._mgr.save(step, args=ocp.args.StandardSave(state))
+        if not self.verify:
+            return
+        # Verified checkpointing trades the async-save overlap for
+        # integrity: the manifest must hash the FINAL bytes, so wait for
+        # the write to land before fingerprinting it. wait_until_finished
+        # is Orbax's cross-process finalize barrier, after which the step
+        # is globally complete. The manifest itself is ONE shared file in
+        # a shared directory: lead-process-only, or N hosts race the same
+        # tmp-and-replace (and pay N redundant sha256 passes).
+        self._mgr.wait_until_finished()
+        if jax.process_index() == 0:
+            self._write_manifest(step)
+            self._prune_aux("manifest_*.json", keyfield=1)
+
+    def _write_manifest(self, step: int) -> None:
+        root = self.step_dir(step)
+        files: dict[str, dict[str, Any]] = {}
+        for dirpath, _, names in os.walk(root):
+            for name in names:
+                if any(name.endswith(s) for s in MANIFEST_SKIP):
+                    continue
+                p = os.path.join(dirpath, name)
+                rel = os.path.relpath(p, root)
+                files[rel] = {
+                    "size": os.path.getsize(p),
+                    "sha256": _sha256_file(p),
+                }
+        _atomic_write_json(
+            self._manifest_path(step), {"step": step, "files": files}
+        )
+
+    def verify_step(self, step: int) -> bool:
+        """True when the step's files match its manifest. A step with no
+        manifest (pre-manifest checkpoint, or ``verify=False`` writer) is
+        trusted — restore still has its own exception fallback."""
+        if step in self._rejected:
+            return False
+        root = self.step_dir(step)
+        if not os.path.isdir(root):
+            return False
+        mpath = self._manifest_path(step)
+        if not os.path.exists(mpath):
+            return True
+        try:
+            with open(mpath) as f:
+                manifest = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            return False
+        for rel, meta in manifest.get("files", {}).items():
+            p = os.path.join(root, rel)
+            if not os.path.exists(p):
+                return False
+            if os.path.getsize(p) != meta["size"]:
+                return False
+            if _sha256_file(p) != meta["sha256"]:
+                return False
+        return True
+
+    def _reject(self, step: int, why: str, sticky: bool = True) -> None:
+        """``sticky`` caches the rejection (manifest mismatches are
+        permanent until re-saved); restore-time exceptions are NOT cached —
+        they may be transient (OOM, storage hiccup) or structural (model
+        config changed), and excluding the step forever would be wrong."""
+        if step in self._rejected:
+            return  # already reported once
+        if sticky:
+            self._rejected.add(step)
+        print(
+            f"[dtc_tpu] WARNING: checkpoint step {step} {why}; "
+            "falling back to an earlier step"
+        )
+        if self._on_event is not None:
+            self._on_event("recovery", action="ckpt_fallback",
+                           rejected_step=step, reason=why)
+
+    def all_steps(self) -> list[int]:
+        return sorted(self._mgr.all_steps())
 
     def latest_step(self) -> int | None:
-        return self._mgr.latest_step()
+        """Newest INTACT step (manifest-verified); None when no checkpoint
+        survives verification."""
+        steps = self.all_steps()
+        if not self.verify:
+            return steps[-1] if steps else None
+        for s in reversed(steps):
+            if self.verify_step(s):
+                return s
+            self._reject(s, "failed integrity verification")
+        return None
 
+    # ---- restore ---------------------------------------------------------
     def restore(self, state_like: PyTree, step: int | None = None) -> PyTree:
         """Restore into the sharding/structure of ``state_like``.
+
+        With ``step=None``, restores the newest step that BOTH passes
+        manifest verification AND actually restores — an unverifiable
+        legacy step that turns out corrupt is caught by Orbax's own raise
+        and the next older intact step is tried.
 
         Every jax.Array leaf gets an explicit NamedSharding on the current
         mesh. Leaves created eagerly outside jit (e.g. scalar AdamW step
@@ -46,13 +233,39 @@ class CheckpointManager:
         VERDICT "What's weak" #2). Those leaves are restored replicated
         (``P()``) on the mesh inferred from the sharded leaves instead.
         """
+        if step is not None:
+            return self._restore_step(step, state_like)
+        state, _ = self.restore_latest(state_like)
+        return state
+
+    def restore_latest(self, state_like: PyTree) -> tuple[PyTree, int]:
+        """Restore the newest intact step; returns ``(state, step)`` so
+        callers (resume, rollback) know which step they actually got."""
+        steps = self.all_steps()
+        if not steps:
+            raise FileNotFoundError(f"no checkpoint under {self._dir}")
+        last_err: Exception | None = None
+        for s in reversed(steps):
+            if self.verify and not self.verify_step(s):
+                self._reject(s, "failed integrity verification")
+                continue
+            try:
+                return self._restore_step(s, state_like), s
+            except Exception as e:  # corrupt beyond what the manifest saw
+                last_err = e
+                self._reject(
+                    s, f"failed to restore ({type(e).__name__})", sticky=False
+                )
+        raise FileNotFoundError(
+            f"no intact checkpoint under {self._dir} "
+            f"(all {len(steps)} candidate step(s) rejected; last error: "
+            f"{type(last_err).__name__ if last_err else 'manifest mismatch'})"
+        ) from last_err
+
+    def _restore_step(self, step: int, state_like: PyTree) -> PyTree:
         import orbax.checkpoint as ocp
         from jax.sharding import NamedSharding
         from jax.sharding import PartitionSpec as P
-
-        step = step if step is not None else self._mgr.latest_step()
-        if step is None:
-            raise FileNotFoundError(f"no checkpoint under {self._dir}")
 
         mesh = None
         for leaf in jax.tree.leaves(state_like):
@@ -69,8 +282,7 @@ class CheckpointManager:
             return x
 
         target = jax.tree.map(as_restore_arg, state_like)
-        restored = self._mgr.restore(step, args=ocp.args.StandardRestore(target))
-        return restored
+        return self._mgr.restore(step, args=ocp.args.StandardRestore(target))
 
     # ---- data-stream position sidecars -----------------------------------
     # The input stream's resume point (documents consumed + packer buffer,
@@ -81,46 +293,63 @@ class CheckpointManager:
     def save_stream(self, step: int, position: dict, process_index: int = 0) -> None:
         """Positions are PER-PROCESS: each pod host consumes a different
         count of its striped documents and holds a different buffer, so
-        every process writes (and later reads) its own sidecar."""
-        import glob
-        import json
+        every process writes (and later reads) its own sidecar. Atomic:
+        a preemption mid-write must not leave truncated JSON that poisons
+        the next resume."""
+        _atomic_write_json(
+            os.path.join(self._dir, f"stream_{step}_p{process_index}.json"),
+            position,
+        )
+        self._prune_aux(f"stream_*_p{process_index}.json", keyfield=1)
 
-        with open(
-            os.path.join(self._dir, f"stream_{step}_p{process_index}.json"), "w"
-        ) as f:
-            json.dump(position, f)
-        # Mirror max_to_keep=3: prune this process's sidecars (Orbax's GC
-        # won't touch them).
+    def _prune_aux(self, pattern: str, keyfield: int) -> None:
+        """Mirror max_to_keep=3 for our auxiliary files (Orbax's GC won't
+        touch them)."""
         paths = sorted(
-            glob.glob(os.path.join(self._dir, f"stream_*_p{process_index}.json")),
-            key=lambda p: int(os.path.basename(p).split("_")[1]),
+            glob.glob(os.path.join(self._dir, pattern)),
+            key=lambda p: int(
+                os.path.basename(p).split("_")[keyfield].split(".")[0]
+            ),
         )
         for p in paths[:-3]:
             os.remove(p)
 
     def load_stream(self, step: int, process_index: int = 0) -> dict | None:
-        import json
-
         path = os.path.join(self._dir, f"stream_{step}_p{process_index}.json")
         if not os.path.exists(path):
             return None  # pre-sidecar checkpoint: caller falls back to drain
-        with open(path) as f:
-            return json.load(f)
+        try:
+            with open(path) as f:
+                return json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            # A legacy (pre-atomic-write) torn sidecar must degrade to the
+            # drain-loop fallback, not kill the resume.
+            print(f"[dtc_tpu] WARNING: unreadable stream sidecar {path} ({e})")
+            return None
 
     def save_eval_set(self, batches: list, process_index: int = 0) -> None:
         """Persist the held-out eval batches (already-materialized numpy
         arrays) so a resume does not re-stream and re-tokenize the dataset
-        head just to rebuild them."""
-        np.savez(
-            os.path.join(self._dir, f"eval_set_p{process_index}.npz"), *batches
-        )
+        head just to rebuild them. Atomic (tmp + os.replace)."""
+        path = os.path.join(self._dir, f"eval_set_p{process_index}.npz")
+        tmp = path + ".tmp"
+        # np.savez appends ".npz" to bare paths but honors open handles.
+        with open(tmp, "wb") as f:
+            np.savez(f, *batches)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
 
     def load_eval_set(self, process_index: int = 0) -> list | None:
         path = os.path.join(self._dir, f"eval_set_p{process_index}.npz")
         if not os.path.exists(path):
             return None
-        with np.load(path) as z:
-            return [z[k] for k in z.files]
+        try:
+            with np.load(path) as z:
+                return [z[k] for k in z.files]
+        except (OSError, ValueError) as e:
+            print(f"[dtc_tpu] WARNING: unreadable eval-set sidecar {path} ({e})")
+            return None
 
     def wait(self) -> None:
         self._mgr.wait_until_finished()

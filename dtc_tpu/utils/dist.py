@@ -14,14 +14,51 @@ import os
 
 _initialized = False
 
+#: Env knob overriding the coordinator-init timeout (seconds). Takes
+#: precedence over TrainConfig.coordinator_timeout_s so an operator can
+#: shorten a stuck pod's hang without editing configs.
+TIMEOUT_ENV = "DTC_COORDINATOR_TIMEOUT_S"
 
-def maybe_initialize_distributed(multihost: bool) -> None:
+
+def _resolve_timeout(timeout_s: int | None) -> int | None:
+    """Effective coordinator timeout: env knob > config > jax default.
+    ``0`` means "jax's default" in BOTH the env knob and the config (so an
+    operator can unset a debugging override without unexporting the var);
+    negative or non-integer values are ignored with a warning."""
+    env = os.environ.get(TIMEOUT_ENV)
+    if env:
+        try:
+            v = int(env)
+        except ValueError:
+            v = None
+        if v is not None and v > 0:
+            return v
+        if v == 0:
+            return None  # explicit "use jax's default", overriding config
+        print(
+            f"[dtc_tpu] WARNING: ignoring invalid {TIMEOUT_ENV}={env!r} "
+            "(want an integer >= 0; 0 = jax's default)"
+        )
+    if timeout_s and timeout_s > 0:
+        return timeout_s
+    return None  # jax's default (300s)
+
+
+def maybe_initialize_distributed(
+    multihost: bool, timeout_s: int | None = None
+) -> None:
     """Initialize the JAX distributed runtime when running multi-process.
 
     MUST be the first JAX-touching call of the process: probing any backend
     API (``jax.process_count()``, ``jax.devices()``, …) first initializes
     the local backend and makes ``jax.distributed.initialize()`` raise on a
     real pod. The gate is therefore env/config only — no JAX probes.
+
+    ``timeout_s`` (config ``coordinator_timeout_s``; env
+    ``DTC_COORDINATOR_TIMEOUT_S`` overrides) bounds how long a worker waits
+    for the coordinator before failing — SURVEY §5: without it a typo'd
+    coordinator address hangs every host for jax's full default and the
+    eventual error never names the likely causes.
 
     Raises on failure when multi-host was explicitly requested (config):
     a pod where every host silently falls back to independent
@@ -40,23 +77,43 @@ def maybe_initialize_distributed(multihost: bool) -> None:
         return
     import jax
 
+    timeout = _resolve_timeout(timeout_s)
+    kwargs = {} if timeout is None else {"initialization_timeout": timeout}
     try:
-        jax.distributed.initialize()
+        jax.distributed.initialize(**kwargs)
     except RuntimeError as e:
         # The embedding program (a launcher, a test harness) may have
         # initialized the distributed runtime itself — that is success,
         # not failure.
         if "already initialized" not in str(e).lower():
+            if multihost:
+                raise RuntimeError(_init_failure_message(timeout)) from e
             raise
-    except Exception:
+    except Exception as e:
         if multihost:
-            raise
+            raise RuntimeError(_init_failure_message(timeout)) from e
         print(
             "[dtc_tpu] WARNING: cluster env vars set but "
             "jax.distributed.initialize() failed; continuing single-process"
         )
         return
     _initialized = True
+
+
+def _init_failure_message(timeout: int | None) -> str:
+    coord = (
+        os.environ.get("JAX_COORDINATOR_ADDRESS")
+        or os.environ.get("COORDINATOR_ADDRESS")
+        or "<auto-detected>"
+    )
+    return (
+        "multi-host initialization failed "
+        f"(coordinator={coord}, timeout={timeout or 'jax default (300s)'}s). "
+        "Common causes: wrong/unreachable coordinator address, a process "
+        "count mismatch (a host never joined), or a firewall blocking the "
+        "coordinator port. Set coordinator_timeout_s in the train config "
+        f"or {TIMEOUT_ENV} to fail faster while debugging."
+    )
 
 
 def is_lead_process() -> bool:

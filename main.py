@@ -52,7 +52,9 @@ def main(
     # any backend-touching JAX API (including jax.device_count below).
     from dtc_tpu.utils.dist import maybe_initialize_distributed
 
-    maybe_initialize_distributed(train_cfg.multihost)
+    maybe_initialize_distributed(
+        train_cfg.multihost, train_cfg.coordinator_timeout_s
+    )
 
     if train_cfg.dataset == "fineweb":
         # vocab_size comes from the tokenizer, as in /root/reference/main.py:17-18.

@@ -128,7 +128,8 @@ def test_fineweb_resume_seeks_via_sidecar(
     calls = []
 
     def fake_host_iterator(train_cfg, model_cfg, skip_batches=0,
-                           seed_offset=0, stream_position=None, history=64):
+                           seed_offset=0, stream_position=None, history=64,
+                           **kw):
         calls.append(stream_position)
         source = docs
         if stream_position is not None:
@@ -219,7 +220,8 @@ def test_fineweb_resume_with_holdout_eval(
     docs = _docs(n=2000, tokens=50)
 
     def fake_host_iterator(train_cfg, model_cfg, skip_batches=0,
-                           seed_offset=0, stream_position=None, history=64):
+                           seed_offset=0, stream_position=None, history=64,
+                           **kw):
         it = FinewebStream(
             train_cfg.batch, seq, documents=docs, position=stream_position,
             history=history,

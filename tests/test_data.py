@@ -143,8 +143,25 @@ class _TailOnlySeq:
         return self._docs[sl]
 
 
-def _docs(n=200, tokens=7):
-    return [list(range(i * tokens, (i + 1) * tokens)) for i in range(n)]
+def _docs(n=200, tokens=7, vocab=97):
+    """Distinct synthetic documents with IN-VOCAB token ids. Earlier
+    versions emitted raw ``range`` ids far beyond the tiny test vocab;
+    out-of-range ids drive the padded-logit CE to +/-1e9 territory and the
+    very first update lands the params on NaN — which went unnoticed
+    because ``assert_allclose(nan, nan)`` passes, but means the fineweb
+    loss-parity tests were comparing NaN to NaN (and the anomaly guard now
+    rightly refuses such a run).
+
+    The two-token header encodes the doc index base-``vocab``, keeping
+    documents globally unique for n < vocab**2 — a naive per-token mod
+    would repeat with period 97 and let a 97-doc positioning bug slip
+    past the seek/resume parity tests."""
+    docs = []
+    for i in range(n):
+        head = [i % vocab, (i // vocab) % vocab]
+        body = [(i * tokens + j) % vocab for j in range(max(tokens - 2, 0))]
+        docs.append((head + body)[:tokens])
+    return docs
 
 
 def test_fineweb_stream_resume_seeks_and_matches():

@@ -1,0 +1,670 @@
+"""Fault-tolerance subsystem (ISSUE 2): chaos harness, anomaly-guard policy
+ladder, verified checkpoints with intact-step fallback, self-healing data
+streams, prefetch error propagation, watchdog, coordinator timeout.
+
+The flagship test injects the full kill chain into one short offline run —
+a transient stream fault, a corrupted latest checkpoint, and a NaN loss —
+and asserts the run completes every step with losses IDENTICAL to an
+uninjected run: recovery must be invisible in the training trajectory.
+"""
+
+import dataclasses
+import glob
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from dtc_tpu.config.schema import (
+    ChaosConfig,
+    GuardConfig,
+    ResilienceConfig,
+    StreamRetryConfig,
+    WatchdogConfig,
+)
+from dtc_tpu.train.trainer import train
+
+pytestmark = pytest.mark.filterwarnings("ignore::DeprecationWarning")
+
+
+# ---------------------------------------------------------------------------
+# retry wrapper
+
+
+def test_resilient_iterator_heals_at_exact_position():
+    from dtc_tpu.resilience.retry import resilient_iterator
+
+    docs = list(range(20))
+    opens = []
+    armed = {"on": True}
+
+    def factory(index):
+        opens.append(index)
+
+        def gen():
+            for off, v in enumerate(docs[index:]):
+                if armed["on"] and index + off == 7:
+                    armed["on"] = False
+                    raise ConnectionError("flaky shard")
+                yield v
+
+        return gen()
+
+    out = list(
+        resilient_iterator(factory, backoff_s=0.0, jitter=0.0, sleep=lambda s: None)
+    )
+    assert out == docs, "exactly-once: no item dropped or replayed"
+    assert opens == [0, 7], "re-opened at the exact failure index"
+
+
+def test_resilient_iterator_exhausts_to_typed_error():
+    from dtc_tpu.resilience import DataStreamError
+    from dtc_tpu.resilience.retry import resilient_iterator
+
+    sleeps = []
+
+    def factory(index):
+        raise ConnectionError("network down")
+
+    it = resilient_iterator(
+        factory, max_attempts=3, backoff_s=1.0, backoff_max_s=10.0,
+        jitter=0.0, sleep=sleeps.append,
+    )
+    with pytest.raises(DataStreamError, match="3 consecutive attempts") as ei:
+        next(it)
+    assert isinstance(ei.value.__cause__, ConnectionError)
+    assert sleeps == [1.0, 2.0], "exponential backoff, attempts-1 sleeps"
+
+
+def test_resilient_iterator_cancel_interrupts_backoff():
+    import threading
+
+    from dtc_tpu.resilience.retry import resilient_iterator
+
+    cancel = threading.Event()
+
+    def factory(index):
+        raise ConnectionError("down")
+
+    it = resilient_iterator(
+        factory, max_attempts=5, backoff_s=3600.0, jitter=0.0, cancel=cancel
+    )
+    cancel.set()
+    assert list(it) == [], "cancelled stream ends immediately, no backoff sleep"
+
+
+# ---------------------------------------------------------------------------
+# anomaly guard ladder
+
+
+def test_guard_ladder_rollback_then_abort():
+    from dtc_tpu.resilience import AnomalyGuard
+
+    g = AnomalyGuard(GuardConfig(max_rollbacks=1), can_rollback=True)
+    assert g.check_window(1, [1.0, 0.9]).action == "ok"
+    d = g.check_window(2, [float("nan"), 0.8])
+    assert d.action == "rollback" and "non-finite" in d.reason
+    g.note_rollback()
+    assert g.check_window(3, [float("inf")]).action == "abort"
+
+
+def test_guard_without_checkpoint_only_warns():
+    from dtc_tpu.resilience import AnomalyGuard
+
+    g = AnomalyGuard(GuardConfig(), can_rollback=False)
+    assert g.check_window(1, [float("nan")]).action == "warn"
+
+
+def test_guard_spike_detection_vs_trailing_median():
+    from dtc_tpu.resilience import AnomalyGuard
+
+    g = AnomalyGuard(GuardConfig(spike_factor=3.0), can_rollback=True)
+    for s in range(1, 6):
+        assert g.check_window(s, [1.0, 1.1]).action == "ok"
+    d = g.check_window(6, [10.0, 11.0])
+    assert d.action == "rollback" and "spike" in d.reason
+
+
+def test_guard_tolerates_when_updates_skipped_device_side():
+    from dtc_tpu.resilience import AnomalyGuard
+
+    g = AnomalyGuard(
+        GuardConfig(skip_nonfinite_updates=True, max_consecutive_skips=2),
+        can_rollback=True,
+    )
+    assert g.check_window(1, [float("nan")]).action == "tolerate"
+    assert g.check_window(2, [float("nan")]).action == "tolerate"
+    assert g.check_window(3, [float("nan")]).action == "rollback"
+    # a healthy window resets the consecutive-skip budget
+    g.note_rollback()
+    assert g.check_window(4, [1.0]).action == "ok"
+    assert g.check_window(5, [float("nan")]).action == "tolerate"
+
+
+def test_guard_healthy_loss_rejects_finite_spike():
+    from dtc_tpu.resilience import AnomalyGuard
+
+    g = AnomalyGuard(GuardConfig(spike_factor=3.0), can_rollback=True)
+    for s in range(1, 6):
+        g.check_window(s, [1.0, 1.1])
+    assert g.healthy_loss(1.2)
+    assert not g.healthy_loss(10.0), "finite spike must not be checkpointed"
+    assert not g.healthy_loss(float("nan"))
+
+
+# ---------------------------------------------------------------------------
+# watchdog
+
+
+def test_watchdog_flags_outlier_without_poisoning_median():
+    from dtc_tpu.resilience import StepWatchdog
+
+    wd = StepWatchdog(WatchdogConfig(enabled=True, factor=5.0, min_samples=3))
+    for s in range(1, 6):
+        assert wd.observe(s, 0.1) is None
+    flag = wd.observe(6, 1.0)
+    assert flag is not None and flag["step"] == 6 and flag["factor"] >= 5.0
+    # the outlier is excluded from the trailing median
+    assert wd.observe(7, 0.1) is None and wd.flags == 1
+
+
+def test_watchdog_hard_timeout_interrupts_main():
+    from dtc_tpu.resilience import StepWatchdog
+
+    hits = []
+    wd = StepWatchdog(
+        WatchdogConfig(enabled=True, hard_timeout_s=0.05),
+        interrupt=lambda: hits.append(1),
+    )
+    wd.start()
+    wd.arm(step=1)
+    deadline = time.monotonic() + 5.0
+    while not hits and time.monotonic() < deadline:
+        time.sleep(0.01)
+    wd.stop()
+    assert hits and wd.timed_out
+
+
+# ---------------------------------------------------------------------------
+# prefetch error paths (satellite: original exception, never a silent hang)
+
+
+def _mesh_and_spec():
+    from jax.sharding import PartitionSpec as P
+
+    from dtc_tpu.parallel.mesh import build_mesh
+
+    return build_mesh((1, 8, 1)), P("data", None)
+
+
+def test_prefetch_worker_exception_reaches_consumer_as_original():
+    from dtc_tpu.data.prefetch import ShardedPrefetchIterator
+
+    class TokenizerBoom(RuntimeError):
+        pass
+
+    def source():
+        yield np.zeros((8, 9), np.int32)
+        raise TokenizerBoom("bad document")
+
+    mesh, spec = _mesh_and_spec()
+    pre = ShardedPrefetchIterator(source(), mesh, spec, queue_size=2)
+    next(pre)
+    with pytest.raises(TokenizerBoom, match="bad document"):
+        next(pre)
+
+
+def test_prefetch_dead_worker_raises_typed_error_not_hang(monkeypatch):
+    from dtc_tpu.data.prefetch import ShardedPrefetchIterator
+    from dtc_tpu.resilience import DataStreamError
+
+    # A worker that dies WITHOUT delivering its error sentinel (C-level
+    # crash analog): the consumer must get a typed error via the liveness
+    # check, not block on queue.get forever.
+    monkeypatch.setattr(ShardedPrefetchIterator, "_worker", lambda self: None)
+    monkeypatch.setattr(ShardedPrefetchIterator, "_POLL_S", 0.05)
+    mesh, spec = _mesh_and_spec()
+    pre = ShardedPrefetchIterator(iter([]), mesh, spec, queue_size=1)
+    with pytest.raises(DataStreamError, match="died without"):
+        next(pre)
+
+
+def test_prefetch_close_stops_worker_thread():
+    from dtc_tpu.data.prefetch import ShardedPrefetchIterator
+
+    def endless():
+        while True:
+            yield np.zeros((8, 9), np.int32)
+
+    mesh, spec = _mesh_and_spec()
+    pre = ShardedPrefetchIterator(endless(), mesh, spec, queue_size=1)
+    next(pre)
+    pre.close()
+    assert not pre._thread.is_alive(), "close() must reap the worker"
+    pre.close()  # idempotent
+
+
+# ---------------------------------------------------------------------------
+# verified checkpoints + atomic sidecars
+
+
+def _mini_state(v: float):
+    import jax.numpy as jnp
+
+    return {"params": {"w": jnp.full((4, 4), float(v), jnp.float32)},
+            "count": jnp.asarray(int(v), jnp.int32)}
+
+
+def _corrupt_largest_file(root: str) -> str:
+    target, size = None, -1
+    for dirpath, _, names in os.walk(root):
+        for name in names:
+            p = os.path.join(dirpath, name)
+            s = os.path.getsize(p)
+            if s > size:
+                target, size = p, s
+    with open(target, "r+b") as f:
+        f.truncate(size // 2)
+    return target
+
+
+def test_checkpoint_manifest_written_and_verified(tmp_path):
+    from dtc_tpu.utils.checkpoint import CheckpointManager
+
+    mgr = CheckpointManager(str(tmp_path))
+    mgr.save(2, _mini_state(2))
+    manifest = json.load(open(tmp_path / "manifest_2.json"))
+    assert manifest["step"] == 2 and manifest["files"], "non-empty manifest"
+    assert mgr.verify_step(2)
+    mgr.close()
+
+
+def test_corrupt_latest_falls_back_to_intact_step(tmp_path):
+    from dtc_tpu.utils.checkpoint import CheckpointManager
+
+    events = []
+    mgr = CheckpointManager(
+        str(tmp_path), on_event=lambda e, **f: events.append((e, f))
+    )
+    mgr.save(2, _mini_state(2))
+    mgr.save(4, _mini_state(4))
+    assert mgr.latest_step() == 4
+    _corrupt_largest_file(mgr.step_dir(4))
+    assert not mgr.verify_step(4)
+    assert mgr.latest_step() == 2, "latest_step skips the corrupt step"
+    restored, step = mgr.restore_latest(_mini_state(0))
+    assert step == 2
+    np.testing.assert_array_equal(np.asarray(restored["params"]["w"]), 2.0)
+    assert any(
+        e == "recovery" and f["action"] == "ckpt_fallback" for e, f in events
+    ), "fallback must be reported for telemetry"
+    mgr.close()
+
+
+def test_save_overwrites_stale_step_after_rollback(tmp_path):
+    from dtc_tpu.utils.checkpoint import CheckpointManager
+
+    mgr = CheckpointManager(str(tmp_path))
+    mgr.save(2, _mini_state(2))
+    _corrupt_largest_file(mgr.step_dir(2))
+    mgr.save(2, _mini_state(7))  # replay past a rollback re-saves the step
+    assert mgr.verify_step(2) and mgr.latest_step() == 2
+    restored, _ = mgr.restore_latest(_mini_state(0))
+    np.testing.assert_array_equal(np.asarray(restored["params"]["w"]), 7.0)
+    mgr.close()
+
+
+def test_sidecars_atomic_and_tolerant_of_torn_files(tmp_path):
+    from dtc_tpu.utils.checkpoint import CheckpointManager
+
+    mgr = CheckpointManager(str(tmp_path), verify=False)
+    mgr.save_stream(2, {"position": {"docs_consumed": 5, "buffer": [1, 2]},
+                        "stream_index": 3}, 0)
+    assert not glob.glob(str(tmp_path / "*.tmp")), "no temp litter"
+    assert mgr.load_stream(2, 0)["stream_index"] == 3
+    # a torn (pre-atomic-era) sidecar degrades to the drain fallback
+    (tmp_path / "stream_4_p0.json").write_text('{"position": {"docs')
+    assert mgr.load_stream(4, 0) is None
+    # eval-set npz: round-trip + torn-file tolerance
+    batches = [np.arange(6, dtype=np.int32).reshape(2, 3)]
+    mgr.save_eval_set(batches, 0)
+    assert not glob.glob(str(tmp_path / "*.tmp"))
+    np.testing.assert_array_equal(mgr.load_eval_set(0)[0], batches[0])
+    (tmp_path / "eval_set_p1.npz").write_bytes(b"not an npz")
+    assert mgr.load_eval_set(1) is None
+    mgr.close()
+
+
+# ---------------------------------------------------------------------------
+# coordinator-init timeout (satellite)
+
+
+def test_coordinator_timeout_plumbed_env_beats_config(monkeypatch):
+    import jax
+
+    import dtc_tpu.utils.dist as dist
+
+    calls = {}
+
+    class FakeDistributed:
+        def initialize(self, **kw):
+            calls.update(kw or {"<none>": True})
+            raise Exception("coordinator unreachable")
+
+    monkeypatch.setattr(dist, "_initialized", False)
+    monkeypatch.setattr(jax, "distributed", FakeDistributed())
+    monkeypatch.setenv(dist.TIMEOUT_ENV, "7")
+    with pytest.raises(RuntimeError, match="coordinator"):
+        dist.maybe_initialize_distributed(True, 99)
+    assert calls == {"initialization_timeout": 7}, "env knob wins over config"
+
+    calls.clear()
+    monkeypatch.setenv(dist.TIMEOUT_ENV, "0")  # 0 = restore jax's default
+    with pytest.raises(RuntimeError):
+        dist.maybe_initialize_distributed(True, 99)
+    assert calls == {"<none>": True}, "env 0 means jax default, not timeout=0"
+
+    calls.clear()
+    monkeypatch.delenv(dist.TIMEOUT_ENV)
+    with pytest.raises(RuntimeError, match="Common causes"):
+        dist.maybe_initialize_distributed(True, 99)
+    assert calls == {"initialization_timeout": 99}, "config value plumbed"
+
+
+# ---------------------------------------------------------------------------
+# config plumbing
+
+
+def test_resilience_yaml_block_loads_typed(tmp_path):
+    from dtc_tpu.config.loader import load_yaml_dataclass
+    from dtc_tpu.config.schema import TrainConfig
+
+    p = tmp_path / "t.yaml"
+    p.write_text(
+        "seed: 0\nparallel: dp\nbatch: 8\nsteps: 2\nlog_every: 1\n"
+        "output_dir: ''\n"
+        "resilience:\n"
+        "  guard: {spike_factor: 2.5, max_rollbacks: 1}\n"
+        "  watchdog: {enabled: true, factor: 4.0}\n"
+        "  stream_retry: {max_attempts: 2, backoff_s: 0.5}\n"
+        "  chaos: {enabled: true, nan_at_step: 3}\n"
+    )
+    cfg = load_yaml_dataclass(p, TrainConfig)
+    assert cfg.resilience.guard.spike_factor == 2.5
+    assert cfg.resilience.watchdog.enabled and cfg.resilience.watchdog.factor == 4.0
+    assert cfg.resilience.stream_retry.max_attempts == 2
+    assert cfg.resilience.chaos.enabled and cfg.resilience.chaos.nan_at_step == 3
+
+
+def test_chaos_config_validates():
+    with pytest.raises(ValueError, match="corrupt_mode"):
+        ChaosConfig(corrupt_mode="scribble")
+    with pytest.raises(ValueError, match="factor"):
+        WatchdogConfig(factor=0.5)
+
+
+# ---------------------------------------------------------------------------
+# end-to-end chaos runs (the acceptance scenario)
+
+
+def _dropout_model(tiny_model_cfg):
+    # dropout ON so the rollback replay also proves RNG-stream re-seek.
+    return tiny_model_cfg.__class__(
+        **{**tiny_model_cfg.__dict__, "dropout": 0.1}
+    )
+
+
+def _fineweb_fake(monkeypatch, docs, seq):
+    """Route make_host_iterator to an injected offline document list,
+    passing the trainer's chaos/retry wiring through — the exact path a
+    network FinewebStream takes, minus the network."""
+    from dtc_tpu.data.fineweb import FinewebStream
+    from dtc_tpu.train import trainer as trainer_mod
+
+    def fake(train_cfg, model_cfg, skip_batches=0, seed_offset=0,
+             stream_position=None, history=64, chaos=None, on_recovery=None,
+             cancel=None):
+        it = FinewebStream(
+            train_cfg.batch, seq, documents=docs, position=stream_position,
+            history=history, retry=train_cfg.resilience.stream_retry,
+            chaos=chaos, on_recovery=on_recovery, cancel=cancel,
+        )
+        for _ in range(skip_batches):
+            next(it)
+        return it
+
+    monkeypatch.setattr(trainer_mod, "make_host_iterator", fake)
+
+
+def _read_events(output_dir):
+    path = os.path.join(output_dir, "obs", "events.r0.jsonl")
+    with open(path) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+def test_chaos_end_to_end_recovery(
+    train_cfg_factory, tiny_model_cfg, opt_cfg, tmp_path, monkeypatch
+):
+    """Acceptance: inject (a) a transient fineweb stream error, (b) a
+    corrupted latest checkpoint, (c) a NaN loss at step 5 into one short
+    offline run. The run must complete all steps, record the recovery
+    events, end with a finite loss, and — because the rollback restores a
+    verified checkpoint and re-seeks the stream — produce losses IDENTICAL
+    to an uninjected run."""
+    from tests.test_data import _docs
+
+    model_cfg = _dropout_model(tiny_model_cfg)
+    seq = model_cfg.max_seq_len + 1
+    _fineweb_fake(monkeypatch, _docs(n=3000, tokens=50), seq)
+
+    base = dict(
+        steps=8, warmup_steps=2, log_every=1, dataset="fineweb",
+        checkpoint_every=2,
+    )
+    clean_cfg = train_cfg_factory(
+        "dp", output_dir=str(tmp_path / "clean"),
+        checkpoint_dir=str(tmp_path / "clean_ckpt"), **base,
+    )
+    clean = train(clean_cfg, model_cfg, opt_cfg)
+    assert len(clean.losses) == 8
+
+    res = ResilienceConfig(
+        stream_retry=StreamRetryConfig(backoff_s=0.0, jitter=0.0),
+        chaos=ChaosConfig(
+            enabled=True,
+            data_error_at_doc=30,    # mid-run transient stream fault
+            corrupt_ckpt_at_step=4,  # latest checkpoint at rollback time
+            nan_at_step=5,           # poisons params+loss after step 5
+        ),
+    )
+    chaos_cfg = dataclasses.replace(
+        train_cfg_factory(
+            "dp", output_dir=str(tmp_path / "chaos"),
+            checkpoint_dir=str(tmp_path / "chaos_ckpt"), **base,
+        ),
+        resilience=res,
+    )
+    chaotic = train(chaos_cfg, model_cfg, opt_cfg)
+
+    # The run completed every step and recovered to a finite loss.
+    assert len(chaotic.losses) == 8
+    assert np.isfinite(chaotic.losses[-1])
+    # Stream re-seek parity: the post-rollback trajectory (and therefore the
+    # WHOLE loss list) matches the uninjected run bit-for-bit.
+    np.testing.assert_allclose(chaotic.losses, clean.losses, rtol=1e-6)
+
+    events = _read_events(chaos_cfg.output_dir)
+    kinds = {e["kind"] for e in events if e["etype"] == "chaos"}
+    assert kinds == {"data_error", "ckpt_corrupt", "nan_loss"}
+    actions = [e["action"] for e in events if e["etype"] == "recovery"]
+    assert actions.count("stream_retry") == 1, actions
+    assert actions.count("rollback") == 1, actions
+    assert actions.count("ckpt_fallback") >= 1, actions
+    rb = next(e for e in events if e["etype"] == "recovery"
+              and e["action"] == "rollback")
+    assert rb["to_step"] == 2, "corrupt step 4 skipped, intact step 2 used"
+    assert any(e["etype"] == "anomaly" for e in events)
+
+
+def test_nan_rollback_synthetic_matches_clean(
+    train_cfg_factory, tiny_model_cfg, opt_cfg, tmp_path
+):
+    """Rollback on the synthetic (seeded O(1)-seek) data path: NaN at step 3
+    -> rollback to the step-2 checkpoint -> replay matches the clean run."""
+    model_cfg = _dropout_model(tiny_model_cfg)
+    base = dict(steps=6, warmup_steps=2, log_every=1, checkpoint_every=2)
+    clean = train(
+        train_cfg_factory(
+            "dp", output_dir=str(tmp_path / "clean"),
+            checkpoint_dir=str(tmp_path / "clean_ckpt"), **base,
+        ),
+        model_cfg, opt_cfg,
+    )
+    chaos_cfg = dataclasses.replace(
+        train_cfg_factory(
+            "dp", output_dir=str(tmp_path / "chaos"),
+            checkpoint_dir=str(tmp_path / "chaos_ckpt"), **base,
+        ),
+        resilience=ResilienceConfig(
+            chaos=ChaosConfig(enabled=True, nan_at_step=3)
+        ),
+    )
+    chaotic = train(chaos_cfg, model_cfg, opt_cfg)
+    assert len(chaotic.losses) == 6
+    np.testing.assert_allclose(chaotic.losses, clean.losses, rtol=1e-6)
+
+
+def test_rollback_commits_window_prefix_when_boundaries_misalign(
+    train_cfg_factory, tiny_model_cfg, opt_cfg, tmp_path
+):
+    """checkpoint_every NOT a multiple of log_every: the rollback target
+    (step 4) sits INSIDE the detection window (4..6, last boundary 3). The
+    window's healthy prefix (step 4) must still be committed — no silently
+    dropped steps, losses identical to the clean run."""
+    model_cfg = _dropout_model(tiny_model_cfg)
+    base = dict(steps=6, warmup_steps=2, log_every=3, checkpoint_every=2)
+    clean = train(
+        train_cfg_factory(
+            "dp", output_dir=str(tmp_path / "clean"),
+            checkpoint_dir=str(tmp_path / "clean_ckpt"), **base,
+        ),
+        model_cfg, opt_cfg,
+    )
+    chaotic = train(
+        dataclasses.replace(
+            train_cfg_factory(
+                "dp", output_dir=str(tmp_path / "chaos"),
+                checkpoint_dir=str(tmp_path / "chaos_ckpt"), **base,
+            ),
+            resilience=ResilienceConfig(
+                chaos=ChaosConfig(enabled=True, nan_at_step=5)
+            ),
+        ),
+        model_cfg, opt_cfg,
+    )
+    assert len(chaotic.losses) == len(clean.losses) == 6
+    np.testing.assert_allclose(chaotic.losses, clean.losses, rtol=1e-6)
+    events = _read_events(str(tmp_path / "chaos"))
+    rb = next(e for e in events if e["etype"] == "recovery"
+              and e["action"] == "rollback")
+    assert rb["to_step"] == 4
+
+
+def test_poisoned_checkpoint_is_never_saved(
+    train_cfg_factory, tiny_model_cfg, opt_cfg, tmp_path
+):
+    """checkpoint_every=1 with NaN onset BEFORE the next log boundary: the
+    save at the poisoned step must be SKIPPED (a bit-intact NaN checkpoint
+    would become the rollback target and trap the ladder), so the rollback
+    lands on the last healthy step and the run still matches clean."""
+    model_cfg = _dropout_model(tiny_model_cfg)
+    base = dict(steps=6, warmup_steps=2, log_every=3, checkpoint_every=1)
+    clean = train(
+        train_cfg_factory(
+            "dp", output_dir=str(tmp_path / "clean"),
+            checkpoint_dir=str(tmp_path / "clean_ckpt"), **base,
+        ),
+        model_cfg, opt_cfg,
+    )
+    chaotic = train(
+        dataclasses.replace(
+            train_cfg_factory(
+                "dp", output_dir=str(tmp_path / "chaos"),
+                checkpoint_dir=str(tmp_path / "chaos_ckpt"), **base,
+            ),
+            resilience=ResilienceConfig(
+                chaos=ChaosConfig(enabled=True, nan_at_step=2)
+            ),
+        ),
+        model_cfg, opt_cfg,
+    )
+    assert len(chaotic.losses) == 6
+    np.testing.assert_allclose(chaotic.losses, clean.losses, rtol=1e-6)
+    events = _read_events(str(tmp_path / "chaos"))
+    actions = [e["action"] for e in events if e["etype"] == "recovery"]
+    # saves at poisoned steps 2 and 3 skipped; rollback restores healthy 1
+    assert "skip_checkpoint" in actions
+    rb = next(e for e in events if e["etype"] == "recovery"
+              and e["action"] == "rollback")
+    assert rb["to_step"] == 1
+
+
+def test_chaos_sigterm_checkpoints_sidecar_and_resumes_bit_exact(
+    train_cfg_factory, tiny_model_cfg, opt_cfg, tmp_path, monkeypatch
+):
+    """Satellite: the SIGTERM graceful-stop path via the chaos harness's
+    simulated preemption — checkpoint + stream sidecar written at the stop
+    step, CSV flushed, and a resume=True rerun continues bit-exactly."""
+    from dtc_tpu.utils.checkpoint import CheckpointManager
+    from tests.test_data import _docs
+
+    model_cfg = _dropout_model(tiny_model_cfg)
+    seq = model_cfg.max_seq_len + 1
+    _fineweb_fake(monkeypatch, _docs(n=2000, tokens=50), seq)
+
+    base = dict(
+        steps=6, warmup_steps=2, log_every=1, dataset="fineweb",
+        checkpoint_every=1000,  # only the SIGTERM path saves
+    )
+    full = train(
+        train_cfg_factory(
+            "dp", output_dir=str(tmp_path / "full"),
+            checkpoint_dir=str(tmp_path / "full_ckpt"), **base,
+        ),
+        model_cfg, opt_cfg,
+    )
+
+    pre_cfg = dataclasses.replace(
+        train_cfg_factory(
+            "dp", output_dir=str(tmp_path / "pre"),
+            checkpoint_dir=str(tmp_path / "pre_ckpt"), **base,
+        ),
+        resilience=ResilienceConfig(
+            chaos=ChaosConfig(enabled=True, sigterm_at_step=3)
+        ),
+    )
+    pre = train(pre_cfg, model_cfg, opt_cfg)
+    assert len(pre.losses) == 3, "stopped at the simulated preemption"
+
+    mgr = CheckpointManager(pre_cfg.checkpoint_dir)
+    assert mgr.latest_step() == 3, "checkpoint written at the stop step"
+    assert mgr.load_stream(3, 0) is not None, "stream sidecar written"
+    mgr.close()
+    with open(os.path.join(pre_cfg.output_dir, "log.csv")) as f:
+        assert len(f.read().strip().splitlines()) == 4, "CSV flushed (hdr+3)"
+    events = _read_events(pre_cfg.output_dir)
+    assert any(
+        e["etype"] == "chaos" and e["kind"] == "sigterm" for e in events
+    )
+
+    resumed = train(
+        dataclasses.replace(
+            pre_cfg, output_dir=str(tmp_path / "res"),
+            resilience=ResilienceConfig(),  # chaos off for the rerun
+        ),
+        model_cfg, opt_cfg,
+    )
+    assert len(resumed.losses) == 3
+    np.testing.assert_allclose(resumed.losses, full.losses[3:6], rtol=1e-6)
