@@ -31,6 +31,27 @@ BASELINE_TOKENS_PER_SEC = 27_900.0  # reference DP/TP, SURVEY.md §6
 FLAGSHIP_DIMS = dict(vocab_size=50258, d_model=512, n_layers=12, d_ff=2048)
 
 
+def flagship_model_cfg(heads=16, max_seq_len=512, dropout=0.1, remat=True,
+                       block_q=512, block_kv=512, block_q_bwd=0,
+                       block_kv_bwd=0, moe_experts=0, moe_dispatch="einsum",
+                       moe_capacity_factor=1.25):
+    """The flagship ModelConfig with the sweepable knobs — ONE definition
+    (scripts/bench_common.py re-exports it), so bench rows, the step
+    sweeps, and sweeps deriving MFU from a config cannot drift onto
+    different models."""
+    from dtc_tpu.config.schema import ModelConfig
+
+    return ModelConfig(
+        **FLAGSHIP_DIMS, n_heads=heads,
+        max_seq_len=max_seq_len, dropout=dropout, param_dtype="float32",
+        compute_dtype="bfloat16", attention="auto", remat=remat,
+        attention_block_q=block_q, attention_block_kv=block_kv,
+        attention_block_q_bwd=block_q_bwd, attention_block_kv_bwd=block_kv_bwd,
+        moe_experts=moe_experts, moe_dispatch=moe_dispatch,
+        moe_capacity_factor=moe_capacity_factor,
+    )
+
+
 def run_config(
     batch: int,
     remat: bool,
@@ -39,6 +60,7 @@ def run_config(
     n_heads: int = 16,
     max_seq_len: int = 512,
     moe_experts: int = 0,
+    moe_dispatch: str = "einsum",
     attention_block_q: int = 512,
     attention_block_kv: int = 512,
     attention_block_q_bwd: int = 0,
@@ -49,7 +71,7 @@ def run_config(
     import numpy as np
     from flax import linen as nn
 
-    from dtc_tpu.config.schema import MeshConfig, ModelConfig, OptimConfig, TrainConfig
+    from dtc_tpu.config.schema import MeshConfig, OptimConfig, TrainConfig
     from dtc_tpu.data.synthetic import synthetic_batch_iterator
     from dtc_tpu.models.gpt import GPT
     from dtc_tpu.parallel.mesh import mesh_from_config
@@ -58,15 +80,11 @@ def run_config(
     from dtc_tpu.train.trainer import init_state
     from dtc_tpu.utils.metrics import mfu
 
-    model_cfg = ModelConfig(
-        **FLAGSHIP_DIMS, n_heads=n_heads,
-        max_seq_len=max_seq_len, dropout=0.1, param_dtype="float32",
-        compute_dtype="bfloat16", attention="auto", remat=remat,
-        moe_experts=moe_experts,
-        attention_block_q=attention_block_q,
-        attention_block_kv=attention_block_kv,
-        attention_block_q_bwd=attention_block_q_bwd,
-        attention_block_kv_bwd=attention_block_kv_bwd,
+    model_cfg = flagship_model_cfg(
+        heads=n_heads, max_seq_len=max_seq_len, remat=remat,
+        moe_experts=moe_experts, moe_dispatch=moe_dispatch,
+        block_q=attention_block_q, block_kv=attention_block_kv,
+        block_q_bwd=attention_block_q_bwd, block_kv_bwd=attention_block_kv_bwd,
     )
     opt_cfg = OptimConfig(lr=3e-4, weight_decay=0.1, grad_clip=1.0)
     train_cfg = TrainConfig(
@@ -128,7 +146,7 @@ def run_config(
     step_time = elapsed / bench_steps
     tokens_per_sec = batch * model_cfg.max_seq_len / step_time
     u = mfu(model_cfg, batch, model_cfg.max_seq_len, step_time, jax.device_count())
-    return {
+    res = {
         "step_time_s": round(step_time, 5),
         "tokens_per_sec": round(tokens_per_sec, 1),
         "mfu": round(u, 4) if u is not None else None,
@@ -139,6 +157,16 @@ def run_config(
         "blocked_s": round(max(0.0, elapsed - dispatch) / bench_steps, 6),
         "hbm_bytes_in_use": in_use,
     }
+    if moe_experts > 0:
+        # The dispatch A/B is judged on the useful basis (k·T routed
+        # tokens, dispatch uncounted — implementation-independent); the
+        # hardware basis above additionally credits the einsum path's
+        # structural work. See utils/metrics.py.
+        uu = mfu(model_cfg, batch, model_cfg.max_seq_len, step_time,
+                 jax.device_count(), moe_basis="useful")
+        res["mfu_useful"] = round(uu, 4) if uu is not None else None
+        res["moe_dispatch"] = moe_dispatch
+    return res
 
 
 def decode_bench(batch: int = 8, prompt_len: int = 32, new_tokens: int = 128) -> dict:
@@ -256,6 +284,9 @@ def _safe(label: str, fn, retries: int = 1):
     """Run one bench config; never let a transient tunnel/compile error
     kill the whole bench (the driver records its single JSON line at
     round end — partial results beat none)."""
+    err = "unknown error"  # bound before the loop: `retries` could be -1,
+    # and leaving it to the except-branch makes the return below depend on
+    # loop-iteration order (round-5 ADVICE fragile-binding cleanup).
     for attempt in range(retries + 1):
         try:
             return fn()
@@ -315,11 +346,27 @@ def main() -> None:
             batch=4, remat="block_save_flash", prng_impl="rbg", max_seq_len=4096,
             bench_steps=10, n_heads=4, attention_block_kv=1024,
         )))
-    # MoE: flagship dims with an E=8 top-2 expert FFN (Switch-style einsum
-    # dispatch; MFU uses the MoE-structural FLOP count, metrics.py).
+    # MoE: flagship dims with top-2 expert FFNs — the dispatch-backend A/B
+    # (ops/moe_dispatch.py): einsum vs sort at E=8 and E=16, identical
+    # routing, so step-time deltas are pure dispatch cost. Rows report
+    # both MFU bases ("mfu" = hardware/einsum-structural, "mfu_useful" =
+    # k·T routed tokens — the A/B-honest number); PERF.md MoE section
+    # carries the resulting tables.
     moe = emit("moe_e8_top2_b32", _safe("moe", lambda: run_config(
         batch=32, remat="block_save_flash", prng_impl="rbg", moe_experts=8,
         bench_steps=15,
+    )))
+    emit("moe_e8_top2_b32_sort", _safe("moe_sort", lambda: run_config(
+        batch=32, remat="block_save_flash", prng_impl="rbg", moe_experts=8,
+        moe_dispatch="sort", bench_steps=15,
+    )))
+    emit("moe_e16_top2_b32", _safe("moe_e16", lambda: run_config(
+        batch=32, remat="block_save_flash", prng_impl="rbg", moe_experts=16,
+        bench_steps=15,
+    )))
+    emit("moe_e16_top2_b32_sort", _safe("moe_e16_sort", lambda: run_config(
+        batch=32, remat="block_save_flash", prng_impl="rbg", moe_experts=16,
+        moe_dispatch="sort", bench_steps=15,
     )))
 
     result = {

@@ -61,6 +61,14 @@ class ModelConfig:
     moe_top_k: int = 2               # experts per token
     moe_capacity_factor: float = 1.25  # slots per expert = ceil(T*k*cf/E)
     moe_aux_coef: float = 0.01       # load-balance aux loss coefficient
+    # Dispatch backend (ops/moe_dispatch.py): "einsum" = static one-hot
+    # (B,T,E,cap) dispatch/combine einsums (gather-free, MXU-shaped; cost
+    # grows with E), "sort" = slot-permutation + segment gathers
+    # (MegaBlocks-style, O(B·T·k·d) data movement at any E). Routing
+    # numerics are identical — this is a pure execution-strategy A/B
+    # (bench.py MoE rows measure both; einsum stays default until the
+    # on-chip A/B says otherwise, PERF.md).
+    moe_dispatch: str = "einsum"
     # Dev knob: emit checkify.check guards for traced invariants that
     # cannot raise at trace time (currently the decode-cache write
     # frontier, whose dynamic_update_slice would otherwise CLAMP on
@@ -87,6 +95,26 @@ class ModelConfig:
         if self.moe_experts > 0 and self.moe_capacity_factor <= 0:
             raise ValueError(
                 f"moe_capacity_factor must be > 0, got {self.moe_capacity_factor}"
+            )
+        if self.moe_dispatch not in ("einsum", "sort"):
+            raise ValueError(
+                f"unknown moe_dispatch {self.moe_dispatch!r}; "
+                "expected 'einsum' or 'sort'"
+            )
+        # Block sizes must be positive HERE: a negative value slips through
+        # flash_attention.supports() (Python modulo of negatives is
+        # non-negative) and dies as an opaque Mosaic compile error deep
+        # inside pallas_call. The *_bwd fields allow 0 = "same as forward".
+        if self.attention_block_q <= 0 or self.attention_block_kv <= 0:
+            raise ValueError(
+                f"attention_block_q/kv must be > 0, got "
+                f"{self.attention_block_q}/{self.attention_block_kv}"
+            )
+        if self.attention_block_q_bwd < 0 or self.attention_block_kv_bwd < 0:
+            raise ValueError(
+                f"attention_block_{{q,kv}}_bwd must be >= 0 (0 = same as "
+                f"forward), got {self.attention_block_q_bwd}/"
+                f"{self.attention_block_kv_bwd}"
             )
         if self.remat_mode not in ("none", "block", "block_save_flash", "mlp"):
             raise ValueError(

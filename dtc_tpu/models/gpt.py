@@ -166,24 +166,35 @@ class MoEMLP(nn.Module):
     """Mixture-of-Experts FFN with expert parallelism (beyond the reference,
     which is dense-only — `/root/reference/model/MLP.py`).
 
-    TPU-native design: GShard/Switch-style top-k routing with STATIC
-    capacity slots, expressed entirely as einsums over one-hot dispatch /
-    combine tensors — no gathers, no dynamic shapes; everything rides the
-    MXU and jit-compiles once. Expert tensors carry an "experts" logical
-    axis mapped to the "model" mesh axis, so XLA's partitioner emits the
-    expert-parallel all-to-alls (tokens to their experts' devices and back)
-    exactly as it emits TP collectives — EP is a rule-table entry, not a
-    hand-written comm schedule. Tokens over an expert's capacity are
-    dropped (contribute zero; the residual stream carries them — standard
-    Switch semantics). The load-balance aux loss (Switch eq. 4-6,
-    coefficient pre-applied) is sowed into the "aux_loss" collection; the
-    train step adds it to the CE loss.
+    GShard/Switch-style top-k routing with STATIC capacity slots; this
+    module owns the router and parameters, while the token<->slot
+    permutation is a pluggable backend from ``ops/moe_dispatch.py``
+    (``cfg.moe_dispatch``): ``einsum`` contracts one-hot ``(B,T,E,cap)``
+    dispatch/combine tensors over T (gather-free, MXU-shaped, cost grows
+    with E — PERF.md round 5), ``sort`` executes the same permutation as
+    an int32 slot map + row gathers (MegaBlocks-style, O(B·T·k·d) data
+    movement at any E). Routing — and therefore which tokens reach which
+    expert, the capacity drop policy, and the aux loss — is computed once
+    and shared, so the switch is a pure execution-strategy A/B.
+
+    Expert tensors carry an "experts" logical axis mapped to the "model"
+    mesh axis, so XLA's partitioner emits the expert-parallel collectives
+    (tokens to their experts' devices and back) exactly as it emits TP
+    collectives — EP is a rule-table entry, not a hand-written comm
+    schedule, and holds for both backends (tests/test_collectives_hlo.py).
+    Tokens over an expert's capacity are dropped (contribute zero; the
+    residual stream carries them — standard Switch semantics). The
+    load-balance aux loss (Switch eq. 4-6, coefficient pre-applied) is
+    sowed into the "aux_loss" collection; the train step adds it to the
+    CE loss.
     """
 
     cfg: ModelConfig
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
+        from dtc_tpu.ops import moe_dispatch as md
+
         cfg = self.cfg
         e, k = cfg.moe_experts, cfg.moe_top_k
         cdtype = _dtype(cfg.compute_dtype)
@@ -203,52 +214,35 @@ class MoEMLP(nn.Module):
         bo = self.param("bo", nn.initializers.zeros_init(), (e, d),
                         _dtype(cfg.param_dtype))
 
-        # Routing in fp32 (softmax numerics), per batch row.
+        # Routing in fp32 (softmax numerics), per batch row — shared by
+        # both dispatch backends, bitwise.
         logits = nn.Dense(
             e, name="router", use_bias=False,
             dtype=jnp.float32, param_dtype=jnp.float32,
         )(x.astype(jnp.float32))
-        probs = jax.nn.softmax(logits, axis=-1)              # (B,T,E)
-        gates, idx = jax.lax.top_k(probs, k)                 # (B,T,k)
-        gates = gates / jnp.sum(gates, axis=-1, keepdims=True)
-
-        dispatch = jnp.zeros((b, t, e, cap), jnp.float32)
-        combine = jnp.zeros((b, t, e, cap), jnp.float32)
-        counts = jnp.zeros((b, e), jnp.float32)
-        picked = jnp.zeros((b, t, e), jnp.float32)
-        for j in range(k):
-            m = jax.nn.one_hot(idx[..., j], e, dtype=jnp.float32)   # (B,T,E)
-            picked = picked + m
-            # Slot index within the expert: running count over the sequence
-            # plus everything earlier routing choices already claimed.
-            pos = jnp.cumsum(m, axis=1) - m + counts[:, None, :]
-            keep = jnp.where(pos < cap, m, 0.0)
-            slot = jax.nn.one_hot(pos.astype(jnp.int32), cap) * keep[..., None]
-            dispatch = dispatch + slot
-            combine = combine + slot * gates[..., j][..., None, None]
-            counts = counts + jnp.sum(m, axis=1)
-
-        # Switch load-balance loss: E * sum_e f_e * P_e, f_e = fraction of
-        # routing choices to e, P_e = mean router probability of e.
-        f = jnp.mean(picked, axis=(0, 1)) / k
-        p_mean = jnp.mean(probs, axis=(0, 1))
+        routing = md.top_k_routing(jax.nn.softmax(logits, axis=-1), k, cap)
         self.sow(
             "aux_loss", "load_balance",
-            cfg.moe_aux_coef * e * jnp.sum(f * p_mean),
+            md.load_balance_loss(routing, k, cfg.moe_aux_coef),
         )
 
-        x_e = jnp.einsum("btec,btd->becd", dispatch.astype(cdtype), x)
+        if cfg.moe_dispatch == "sort":
+            x_e = md.sort_dispatch(x, routing, cap)
+        else:
+            # Build the one-hot pair ONCE; dispatch and combine each
+            # consume their half (the buildup is ~18% of the E=8 step).
+            dispatch, combine = md.dispatch_combine_tensors(routing, cap)
+            x_e = md.einsum_dispatch(x, dispatch)
         x_e = nn.with_logical_constraint(x_e, ("batch", "experts", None, "embed"))
-        h = nn.gelu(
-            jnp.einsum("becd,edf->becf", x_e, wi.astype(cdtype))
-            + bi.astype(cdtype)[None, :, None, :]
-        )
-        y_e = (
-            jnp.einsum("becf,efd->becd", h, wo.astype(cdtype))
-            + bo.astype(cdtype)[None, :, None, :]
+        y_e = md.expert_ffn(
+            x_e, wi.astype(cdtype), bi.astype(cdtype),
+            wo.astype(cdtype), bo.astype(cdtype),
         )
         y_e = nn.with_logical_constraint(y_e, ("batch", "experts", None, "embed"))
-        y = jnp.einsum("btec,becd->btd", combine.astype(cdtype), y_e)
+        if cfg.moe_dispatch == "sort":
+            y = md.sort_combine(y_e, routing, cap)
+        else:
+            y = md.einsum_combine(y_e, combine)
         return nn.with_logical_constraint(y, ("batch", "seq", "embed"))
 
 

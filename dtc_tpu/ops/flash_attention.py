@@ -39,6 +39,9 @@ from jax.ad_checkpoint import checkpoint_name
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+if not hasattr(pltpu, "CompilerParams"):  # pragma: no cover — jax 0.4.x name
+    pltpu.CompilerParams = pltpu.TPUCompilerParams
+
 NEG_INF = -1e9  # matches the reference's additive mask value (ops/attention.py)
 _LANES = 128  # TPU lane width (kept for stat-scratch shapes)
 
@@ -908,6 +911,26 @@ def _packed_flash_bwd(block_q, block_kv, g, d, scale,
     if block_kv_bwd:
         block_kv = block_kv_bwd
     nq = t // block_q
+    # Guard ORDER matters (round-5 ADVICE): the T cap must be checked
+    # before the single-tile fast path, or a user tiling override that
+    # resolves to one whole-T tile at T > _PACKED_MAX_T reaches the fused
+    # kernel — whose full-T VMEM scratches then die as an opaque Mosaic
+    # compile OOM instead of this error. flash_causal_attention validates
+    # the same condition at the API surface; this is the defense for
+    # direct _flash_packed callers.
+    if t > _PACKED_MAX_T:
+        if block_kv == t and nq == 1:
+            raise ValueError(
+                f"packed flash backward cannot run whole-T tiles past "
+                f"T={_PACKED_MAX_T} (full-T VMEM scratches): T={t} with "
+                f"block_q={block_q}, block_kv={block_kv}; choose bwd "
+                f"blocks < T"
+            )
+        # Fused kernel's full-T dk/dv VMEM scratches don't fit: split
+        # dq / dkv kernels with O(block) scratch take over.
+        return _packed_split_bwd_call(
+            q, k, v, do, out, lse, block_q, block_kv, g, d, scale
+        )
     if block_kv == t and nq == 1:
         dspec, kvspec = _packed_specs(t, block_q)
         lsespec = pl.BlockSpec((1, 1, block_q, g), lambda bi, gi, i: (bi, gi, i, 0))
@@ -929,12 +952,6 @@ def _packed_flash_bwd(block_q, block_kv, g, d, scale,
             interpret=_interpret(),
         )(q, k, v, do, out, lse)
         return dq, dk, dv
-    if t > _PACKED_MAX_T:
-        # Fused kernel's full-T dk/dv VMEM scratches don't fit: split
-        # dq / dkv kernels with O(block) scratch take over.
-        return _packed_split_bwd_call(
-            q, k, v, do, out, lse, block_q, block_kv, g, d, scale
-        )
     nkv = t // block_kv
     qspec = pl.BlockSpec((1, block_q, _LANES), lambda bi, gi, i, j: (bi, i, gi))
     kvspec = pl.BlockSpec((1, block_kv, _LANES), lambda bi, gi, i, j: (bi, j, gi))
@@ -1008,6 +1025,24 @@ def flash_causal_attention(
             f"flash attention backward tiling unsupported for T={t}, "
             f"block_q_bwd={block_q_bwd}, block_kv_bwd={block_kv_bwd}"
         )
+    # Past _PACKED_MAX_T no kernel can hold a whole-T tile (the one-pass
+    # forward materializes (T, T) scores; fused AND split backwards hold
+    # (T, 128) accumulators) — reject single-tile tilings HERE with the
+    # cause named instead of letting pallas_call die in a Mosaic compile
+    # OOM (round-5 ADVICE guard-order fix; the bwd-side check in
+    # _packed_flash_bwd covers direct kernel callers).
+    if t > _PACKED_MAX_T:
+        for tag, bq_eff, bkv_eff in (
+            ("", block_q, block_kv),
+            ("_bwd", block_q_bwd or block_q, block_kv_bwd or block_kv),
+        ):
+            if bkv_eff == t and bq_eff == t:
+                raise ValueError(
+                    f"flash attention cannot run whole-T tiles past "
+                    f"T={_PACKED_MAX_T}: T={t} with block_q{tag}={bq_eff}, "
+                    f"block_kv{tag}={bkv_eff}; use blocks < T (e.g. the "
+                    f"512/1024 defaults)"
+                )
 
     g = _packed_group(d, h)
     if (block_q_bwd or block_kv_bwd) and g is None:

@@ -1,0 +1,235 @@
+"""MoE token dispatch/combine — the two interchangeable routing backends.
+
+The MoE FFN decomposes into (routing) -> (dispatch) -> (expert FFN) ->
+(combine). Routing — softmax over router logits, top-k choice, gate
+normalization, choice-major capacity fill, the Switch load-balance loss —
+is computed ONCE here (:func:`top_k_routing`) and shared by both dispatch
+backends, so switching ``moe_dispatch`` can never change which tokens go
+where, which assignments are dropped, or the aux loss: only how the
+token<->slot permutation is *executed*.
+
+Backends (``ModelConfig.moe_dispatch``):
+
+- ``einsum`` — GShard/Switch-style static one-hot dispatch/combine tensors
+  ``(B, T, E, cap)`` contracted over T. Gather-free, MXU-shaped, but the
+  dispatch/combine work grows linearly with E·cap: measured ~25-30 ms
+  (~18% of the 162 ms step) at E=8 on a v5e (PERF.md round 5), the cost
+  this module's second backend exists to A/B against.
+- ``sort`` — MegaBlocks-style (Gale et al., 2022) sorted/segmented
+  routing on static capacity: each kept assignment's destination slot
+  ``expert·cap + position`` is already known from routing, so dispatch is
+  an int32 slot->token permutation (scatter of indices, O(B·T·k)) plus a
+  row gather into per-expert contiguous groups ``(B, E, cap, d)``, and
+  combine is a row gather back weighted by the gates. Data movement is
+  O(B·T·k·d) regardless of E — no (B,T,E,cap) tensors anywhere.
+
+Both backends produce the per-expert grouped activations the SAME shape
+``(B, E, cap, d)``, run the identical grouped expert FFN
+(:func:`expert_ffn` — einsum over the stacked ``(E, d, d_ff)`` weights,
+contiguous per-expert token blocks: a blocked matmul), and carry the same
+"experts" logical axis, so the EP rule row (experts -> "model",
+``parallel/sharding.py``) and the all-to-all it induces hold for either.
+
+Everything here is pure jnp — unit-tested against a brute-force per-token
+reference in ``tests/test_moe.py`` and A/B-benched in ``bench.py`` /
+``scripts/sweep_moe.py``.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+MOE_DISPATCH_MODES = ("einsum", "sort")
+
+
+class Routing(NamedTuple):
+    """Routing decisions for one MoE layer, shared by both backends.
+
+    Shapes: B batch, T tokens/row, E experts, k choices/token, cap
+    slots/expert. The capacity fill is CHOICE-major (every token's top-1
+    claims slots across the sequence before any top-2 — GShard's
+    offset-by-previous-round semantics), so ``pos``/``keep`` encode the
+    drop policy exactly; backends must not re-derive it.
+    """
+
+    probs: jax.Array   # (B, T, E) fp32 router softmax
+    gates: jax.Array   # (B, T, k) fp32 renormalized top-k gates
+    idx: jax.Array     # (B, T, k) int32 expert choice per (token, rank)
+    pos: jax.Array     # (B, T, k) int32 slot within the chosen expert
+    keep: jax.Array    # (B, T, k) fp32 1.0 kept / 0.0 capacity-dropped
+    picked: jax.Array  # (B, T, E) fp32 sum of choice one-hots (aux loss)
+    counts: jax.Array  # (B, E) fp32 total assignments per expert (pre-drop)
+
+
+def top_k_routing(probs: jax.Array, k: int, cap: int) -> Routing:
+    """Top-k choices + choice-major static-capacity fill from router
+    ``probs`` (fp32, softmaxed). One definition of the drop policy for
+    every dispatch backend."""
+    b, t, e = probs.shape
+    gates, idx = jax.lax.top_k(probs, k)                     # (B,T,k)
+    gates = gates / jnp.sum(gates, axis=-1, keepdims=True)
+
+    counts = jnp.zeros((b, e), jnp.float32)
+    picked = jnp.zeros((b, t, e), jnp.float32)
+    pos_l, keep_l = [], []
+    for j in range(k):
+        m = jax.nn.one_hot(idx[..., j], e, dtype=jnp.float32)  # (B,T,E)
+        picked = picked + m
+        # Slot index within the expert: running count over the sequence
+        # plus everything earlier routing choices already claimed.
+        pos_e = jnp.cumsum(m, axis=1) - m + counts[:, None, :]
+        keep_e = jnp.where(pos_e < cap, m, 0.0)
+        # Collapse the (B,T,E) grids to per-assignment scalars: at most
+        # one nonzero per (b,t) row (the chosen expert), so the sums are
+        # exact picks, not reductions.
+        pos_l.append(jnp.sum(pos_e * m, axis=-1).astype(jnp.int32))
+        keep_l.append(jnp.sum(keep_e, axis=-1))
+        counts = counts + jnp.sum(m, axis=1)
+
+    return Routing(
+        probs=probs, gates=gates, idx=idx,
+        pos=jnp.stack(pos_l, axis=-1), keep=jnp.stack(keep_l, axis=-1),
+        picked=picked, counts=counts,
+    )
+
+
+def load_balance_loss(r: Routing, k: int, coef: float) -> jax.Array:
+    """Switch load-balance loss (Fedus et al. eq. 4-6), coefficient
+    pre-applied: coef · E · Σ_e f_e · P_e. Pure function of the shared
+    routing, so it is bitwise-identical whichever backend executes."""
+    e = r.probs.shape[-1]
+    f = jnp.mean(r.picked, axis=(0, 1)) / k
+    p_mean = jnp.mean(r.probs, axis=(0, 1))
+    return coef * e * jnp.sum(f * p_mean)
+
+
+def expert_ffn(x_e, wi, bi, wo, bo):
+    """Grouped expert FFN over ``(B, E, cap, d)`` token groups: each
+    expert's ``cap`` tokens are contiguous, so the einsums over the
+    stacked ``(E, d, d_ff)`` weights are blocked per-expert matmuls.
+    Shared verbatim by both backends — only dispatch/combine differ."""
+    h = jax.nn.gelu(
+        jnp.einsum("becd,edf->becf", x_e, wi) + bi[None, :, None, :]
+    )
+    return jnp.einsum("becf,efd->becd", h, wo) + bo[None, :, None, :]
+
+
+# ---------------------------------------------------------------------------
+# einsum backend: one-hot (B,T,E,cap) dispatch/combine tensors
+# ---------------------------------------------------------------------------
+
+
+def dispatch_combine_tensors(r: Routing, cap: int) -> tuple[jax.Array, jax.Array]:
+    """One-hot dispatch/combine tensors ``(B, T, E, cap)`` fp32 from the
+    shared routing — the static einsum-backend permutation encoding.
+
+    fp32 is deliberate: building them in bf16 measured 160.1 vs 158.5 ms
+    (no change — XLA fuses the buildup into its consumers, PERF.md r5).
+    """
+    e = r.probs.shape[-1]
+    k = r.idx.shape[-1]
+    dispatch = None
+    combine = None
+    for j in range(k):
+        m = jax.nn.one_hot(r.idx[..., j], e, dtype=jnp.float32)      # (B,T,E)
+        # one_hot of an out-of-capacity pos is all-zero and keep is 0.0
+        # there too, so dropped assignments vanish from both tensors.
+        slot = (
+            jax.nn.one_hot(r.pos[..., j], cap)                       # (B,T,cap)
+            [..., None, :] * m[..., None] * r.keep[..., j][..., None, None]
+        )                                                            # (B,T,E,cap)
+        dispatch = slot if dispatch is None else dispatch + slot
+        c = slot * r.gates[..., j][..., None, None]
+        combine = c if combine is None else combine + c
+    return dispatch, combine
+
+
+def einsum_dispatch(x: jax.Array, dispatch: jax.Array) -> jax.Array:
+    """Gather-free dispatch: contract the one-hot ``dispatch`` tensor over
+    T. Returns per-expert groups ``(B, E, cap, d)`` in ``x.dtype``.
+
+    Takes the prebuilt tensor (not the Routing) so the caller builds the
+    dispatch/combine pair ONCE per layer — the k-round one-hot buildup is
+    ~18% of the E=8 step (PERF.md) and must not be traced twice.
+    """
+    return jnp.einsum("btec,btd->becd", dispatch.astype(x.dtype), x)
+
+
+def einsum_combine(y_e: jax.Array, combine: jax.Array) -> jax.Array:
+    """Combine ``(B, E, cap, d)`` expert outputs back to ``(B, T, d)``
+    through the prebuilt gate-weighted ``combine`` tensor; dropped tokens
+    contribute zero."""
+    return jnp.einsum("btec,becd->btd", combine.astype(y_e.dtype), y_e)
+
+
+# ---------------------------------------------------------------------------
+# sort backend: slot->token permutation + segment gathers
+# ---------------------------------------------------------------------------
+
+
+def _dest_slots(r: Routing, cap: int) -> jax.Array:
+    """Flat destination slot ``expert·cap + pos`` per assignment
+    ``(B, T, k)`` int32; capacity-dropped assignments point one past the
+    end (E·cap), where scatters drop and gathers are masked out."""
+    e = r.probs.shape[-1]
+    return jnp.where(
+        r.keep > 0.0, r.idx * cap + r.pos, jnp.int32(e * cap)
+    ).astype(jnp.int32)
+
+
+def slot_to_token(r: Routing, cap: int) -> tuple[jax.Array, jax.Array]:
+    """Invert the routing into the slot->token permutation.
+
+    Returns ``(src, filled)``: ``src`` (B, E·cap) int32 maps each expert
+    slot to the token index that fills it (0 where empty — masked by
+    ``filled`` (B, E, cap) fp32). O(B·T·k) int32 scatter; kept slots are
+    written exactly once (slot assignment is a bijection on kept
+    assignments), drops fall off the end via ``mode="drop"``.
+    """
+    b, t, k = r.idx.shape
+    e = r.probs.shape[-1]
+    dest = _dest_slots(r, cap).reshape(b, t * k)
+    tok = jnp.broadcast_to(
+        jnp.arange(t, dtype=jnp.int32)[None, :, None], (b, t, k)
+    ).reshape(b, t * k)
+    src = jnp.zeros((b, e * cap), jnp.int32)
+    src = jax.vmap(lambda s, d, v: s.at[d].set(v, mode="drop"))(src, dest, tok)
+    # A slot (e, c) is filled iff c < min(count_e, cap): per-expert fill
+    # is sequential from 0, so filled slots are a prefix of each segment.
+    filled = (
+        jnp.arange(cap, dtype=jnp.float32)[None, None, :]
+        < jnp.minimum(r.counts, float(cap))[:, :, None]
+    ).astype(jnp.float32)
+    return src, filled
+
+
+def sort_dispatch(x: jax.Array, r: Routing, cap: int) -> jax.Array:
+    """Dispatch by permutation: gather each slot's token row into its
+    expert's contiguous segment. Data moved is O(B·E·cap·d) rows — no
+    (B,T,E,cap) intermediates; empty slots are zeroed so the grouped FFN
+    sees exactly what the einsum backend produces."""
+    b, t, d = x.shape
+    e = r.probs.shape[-1]
+    src, filled = slot_to_token(r, cap)
+    x_e = jnp.take_along_axis(x, src[..., None], axis=1)     # (B, E·cap, d)
+    x_e = x_e * filled.reshape(b, e * cap, 1).astype(x.dtype)
+    return x_e.reshape(b, e, cap, d)
+
+
+def sort_combine(y_e: jax.Array, r: Routing, cap: int) -> jax.Array:
+    """Combine by permutation: gather each assignment's expert output from
+    its slot and sum the k gate-weighted contributions per token. Dropped
+    assignments gather slot 0 of a clipped index but are zeroed by
+    ``keep`` (the residual stream carries those tokens, Switch
+    semantics)."""
+    b, e, cap_, d = y_e.shape
+    t, k = r.idx.shape[1], r.idx.shape[-1]
+    dest = _dest_slots(r, cap)                               # (B, T, k)
+    flat = y_e.reshape(b, e * cap, d)
+    safe = jnp.minimum(dest, e * cap - 1).reshape(b, t * k)
+    y_a = jnp.take_along_axis(flat, safe[..., None], axis=1).reshape(b, t, k, d)
+    w = (r.gates * r.keep).astype(y_e.dtype)                 # (B, T, k)
+    return jnp.sum(y_a * w[..., None], axis=2)

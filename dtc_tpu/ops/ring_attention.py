@@ -45,6 +45,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
+from dtc_tpu.utils.compat import shard_map
+
 NEG_INF = -1e9
 
 
@@ -58,10 +60,13 @@ def _ambient_mesh():
     shard_map requires the passed mesh to match it exactly). Falls back to
     the physical mesh installed by the trainer's ``with mesh:`` context.
     """
-    from jax.sharding import get_abstract_mesh
+    try:
+        from jax.sharding import get_abstract_mesh
+    except ImportError:  # jax 0.4.x keeps it private
+        from jax._src.mesh import get_abstract_mesh
 
     amesh = get_abstract_mesh()
-    if not amesh.empty:
+    if amesh is not None and not amesh.empty:
         return amesh
     from jax._src.mesh import thread_resources
 
@@ -453,7 +458,7 @@ def ring_causal_attention(
         return from_zigzag(out, idx).astype(q_blk.dtype)
 
     spec = P(None, axis_name, None, None)
-    return jax.shard_map(
+    return shard_map(
         local_ring,
         mesh=mesh,
         in_specs=(spec, spec, spec),
@@ -507,7 +512,7 @@ def _uniform_ring(q, k, v, axis_name, mesh, ring, scale):
         return out.astype(q_blk.dtype)
 
     spec = P(None, axis_name, None, None)
-    return jax.shard_map(
+    return shard_map(
         local_ring,
         mesh=mesh,
         in_specs=(spec, spec, spec),

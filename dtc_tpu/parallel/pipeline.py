@@ -51,6 +51,8 @@ from dtc_tpu.parallel.sharding import (
     logical_to_spec,
 )
 
+from dtc_tpu.utils.compat import shard_map
+
 PyTree = Any
 
 
@@ -317,7 +319,7 @@ def create_pp_train_step(
         return loss, {"embed": g_embed, "stage": g_stage, "head": g_head}
 
     param_pipe_specs = {"embed": P(), "stage": P("pipe"), "head": P()}
-    sharded_fwd_bwd = jax.shard_map(
+    sharded_fwd_bwd = shard_map(
         fwd_bwd,
         mesh=mesh,
         in_specs=(param_pipe_specs, P(), P(), P()),
@@ -783,7 +785,7 @@ def create_1f1b_train_step(
         return loss, {"embed": g_embed, "stage": g_stage, "head": g_head}
 
     param_pipe_specs = {"embed": P(), "stage": P("pipe"), "head": P()}
-    sharded_fwd_bwd = jax.shard_map(
+    sharded_fwd_bwd = shard_map(
         fwd_bwd,
         mesh=mesh,
         in_specs=(param_pipe_specs, P(), P(), P()),

@@ -54,7 +54,12 @@ DEFAULT_RULES: tuple[tuple[str, str | None], ...] = (
     # expert params shards over "model" — XLA emits the token<->expert
     # all-to-alls from these two entries alone. The experts' d_ff axis
     # stays unsharded (one mesh axis cannot shard two axes of one tensor).
-    ("experts", "model"),     # expert axis of dispatch/combine activations
+    # BOTH dispatch backends (ops/moe_dispatch.py einsum | sort) constrain
+    # their (B, E, cap, d) expert groups with the same "experts" axis, so
+    # these rows are the whole EP story for either; the all-to-alls'
+    # presence per backend is pinned on compiled HLO in
+    # tests/test_collectives_hlo.py.
+    ("experts", "model"),     # expert axis of grouped-token activations
     ("experts_p", "model"),   # expert axis of expert PARAMS (EP memory win)
 )
 

@@ -1,0 +1,59 @@
+"""jax version compatibility shims.
+
+The codebase targets the current jax API; this module maps the few
+surfaces that moved between 0.4.x and 0.5+ so the same call sites run on
+either. Keep it tiny — anything that needs real per-version logic belongs
+at its call site with a comment, not here.
+"""
+
+from __future__ import annotations
+
+import inspect
+from typing import Any
+
+import jax
+
+
+def _resolve_shard_map():
+    """Pick the shard_map entry point and its kwarg dialect by SIGNATURE,
+    not by where the function lives: there are three eras — experimental
+    with ``auto``/``check_rep`` (0.4.x), top-level ``jax.shard_map`` still
+    with ``auto``/``check_rep``, and top-level with ``axis_names``/
+    ``check_vma``. Feature-detecting only the attribute would pass the
+    newest kwargs to the middle era and TypeError on every call."""
+    fn = getattr(jax, "shard_map", None)
+    if fn is None:
+        from jax.experimental.shard_map import shard_map as fn
+    params = inspect.signature(fn).parameters
+    modern = "axis_names" in params or any(
+        p.kind is inspect.Parameter.VAR_KEYWORD for p in params.values()
+    )
+    return fn, modern
+
+
+_SHARD_MAP, _MODERN_KWARGS = _resolve_shard_map()
+
+
+def shard_map(
+    f,
+    mesh,
+    in_specs,
+    out_specs,
+    axis_names: set[str] | None = None,
+    check_vma: bool = True,
+) -> Any:
+    """``jax.shard_map`` with the modern signature, on any supported jax.
+
+    Modern dialect: ``axis_names`` = the axes the region is manual over,
+    ``check_vma``. Legacy dialect spells the same contract ``auto`` (the
+    *complement* of the manual axes) and ``check_rep``.
+    """
+    if _MODERN_KWARGS:
+        kwargs: dict[str, Any] = {"check_vma": check_vma}
+        if axis_names is not None:
+            kwargs["axis_names"] = axis_names
+    else:
+        kwargs = {"check_rep": check_vma}
+        if axis_names is not None:
+            kwargs["auto"] = frozenset(mesh.axis_names) - set(axis_names)
+    return _SHARD_MAP(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kwargs)

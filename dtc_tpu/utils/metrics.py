@@ -66,24 +66,59 @@ def moe_step_flops(cfg: ModelConfig, batch: int, seq_len: int) -> float:
     """
     from dtc_tpu.models.gpt import moe_capacity
 
-    assert cfg.moe_experts > 0
-    e, cap, d, ff = cfg.moe_experts, moe_capacity(seq_len, cfg), cfg.d_model, cfg.d_ff
-    tokens = batch * seq_len
-    # Dense accounting minus the router/expert params — a token does NOT
-    # visit every expert, so their FLOPs are counted structurally below,
-    # not via 6N.
-    n = param_count(cfg)
-    n_matmul = n - cfg.padded_vocab_size * cfg.d_model - cfg.max_seq_len * cfg.d_model
-    n_moe = cfg.n_layers * (d * e + e * 2 * d * ff)
-    dense = 6.0 * (n_matmul - n_moe) * tokens
-    attn = 12.0 * cfg.n_layers * batch * (seq_len**2) * d / 2.0
+    cap = moe_capacity(seq_len, cfg)
+    dense, attn = _moe_non_expert_flops(cfg, batch, seq_len)
+    d, e, ff = cfg.d_model, cfg.moe_experts, cfg.d_ff
+    # Expert FFN on the same 2-FLOPs-per-param-per-token convention the
+    # dense 6N term uses (biases included), over the e·cap static slots.
     per_layer_moe = (
-        2.0 * batch * seq_len * d * e              # router
-        + 2.0 * 2.0 * batch * seq_len * e * cap * d  # dispatch + combine
-        + 2.0 * 2.0 * batch * e * cap * d * ff       # wi + wo
+        2.0 * batch * seq_len * d * e                    # router
+        + 2.0 * 2.0 * batch * seq_len * e * cap * d      # dispatch + combine
+        + 2.0 * batch * e * cap * (2 * d * ff + ff + d)  # expert FFN
     )
     moe = 3.0 * cfg.n_layers * per_layer_moe       # fwd + 2x bwd
     return dense + attn + moe
+
+
+def _moe_non_expert_flops(cfg: ModelConfig, batch: int, seq_len: int) -> tuple[float, float]:
+    """Shared prelude of both MoE FLOP bases: (dense-6N minus the MoE
+    block, attention). Dense accounting excludes the router/expert params
+    — a token does NOT visit every expert, so their FLOPs are counted
+    structurally by each basis — and the subtracted block must be the
+    FULL per-layer MoE param count from param_count: router + wi/bi/wo/bo
+    INCLUDING the per-expert biases (round-5 ADVICE: omitting the
+    e·(ff+d) bias params left them double-counted via the 6N term). One
+    definition so a future accounting fix cannot skew the hardware-vs-
+    useful comparison by landing in only one basis."""
+    assert cfg.moe_experts > 0
+    d, e, ff = cfg.d_model, cfg.moe_experts, cfg.d_ff
+    n = param_count(cfg)
+    n_matmul = n - cfg.padded_vocab_size * d - cfg.max_seq_len * d
+    n_moe = cfg.n_layers * (d * e + e * (2 * d * ff + ff + d))
+    dense = 6.0 * (n_matmul - n_moe) * batch * seq_len
+    attn = 12.0 * cfg.n_layers * batch * (seq_len**2) * d / 2.0
+    return dense, attn
+
+
+def moe_step_flops_useful(cfg: ModelConfig, batch: int, seq_len: int) -> float:
+    """Useful-FLOPs basis for the MoE step: only the k·T routed
+    token-expert assignments count (no capacity slack — drops still
+    count, matching Switch's nominal compute), dispatch/combine are
+    uncounted bookkeeping.
+
+    This basis is dispatch-implementation-independent, so it is the
+    honest denominator-free A/B metric between ``moe_dispatch`` backends
+    (``moe_step_flops`` counts the einsum backend's structural work —
+    capacity slack and the (B,T,E,cap) contractions — which the sort
+    backend does not schedule). PERF.md reports both.
+    """
+    dense, attn = _moe_non_expert_flops(cfg, batch, seq_len)
+    d, e, ff, k = cfg.d_model, cfg.moe_experts, cfg.d_ff, cfg.moe_top_k
+    per_layer_moe = (
+        2.0 * batch * seq_len * d * e                          # router
+        + 2.0 * batch * seq_len * k * (2 * d * ff + ff + d)    # k assignments/token
+    )
+    return dense + attn + 3.0 * cfg.n_layers * per_layer_moe
 
 
 def _dtype_bytes(dtype: str) -> int:
@@ -152,13 +187,29 @@ def comm_bytes_per_step(
     }
 
 
-def mfu(cfg: ModelConfig, batch: int, seq_len: int, step_time_s: float, n_chips: int) -> float | None:
+def mfu(
+    cfg: ModelConfig,
+    batch: int,
+    seq_len: int,
+    step_time_s: float,
+    n_chips: int,
+    moe_basis: str = "hardware",
+) -> float | None:
+    """Model FLOPs utilization; None off-TPU or at zero step time.
+
+    ``moe_basis`` selects the MoE FLOP accounting (dense models ignore
+    it): "hardware" = :func:`moe_step_flops` (einsum-structural work,
+    capacity slack + dispatch counted), "useful" =
+    :func:`moe_step_flops_useful` (k·T routed tokens only — the
+    dispatch-backend-independent A/B number the PERF.md MoE tables lead
+    with).
+    """
     peak = peak_flops_per_chip()
     if peak is None or step_time_s <= 0:
         return None
-    flops = (
-        moe_step_flops(cfg, batch, seq_len)
-        if cfg.moe_experts > 0
-        else gpt_step_flops(cfg, batch, seq_len)
-    )
+    if cfg.moe_experts > 0:
+        fn = moe_step_flops_useful if moe_basis == "useful" else moe_step_flops
+        flops = fn(cfg, batch, seq_len)
+    else:
+        flops = gpt_step_flops(cfg, batch, seq_len)
     return flops / (step_time_s * peak * n_chips)

@@ -16,16 +16,20 @@ if REPO not in sys.path:
     sys.path.insert(0, REPO)
 
 
-def build_step(batch=32, heads=16, max_seq_len=512, dropout=0.1, remat=True,
-               grad_clip=1.0, weight_decay=0.1, block_q=512, block_kv=512,
-               block_q_bwd=0, block_kv_bwd=0, moe_experts=0):
+# ONE flagship config definition, owned by bench.py (REPO is on sys.path
+# above): bench rows, the sweeps, and anything deriving MFU from a config
+# all build the same model.
+from bench import flagship_model_cfg  # noqa: E402  (re-export for scripts)
+
+
+def build_step(batch=32, grad_clip=1.0, weight_decay=0.1, **model_knobs):
     """Returns (step_fn, state, batch_obj, key, (mesh, rules), model_cfg)
     for the flagship GPT-89.6M train step with the given knobs."""
     import jax
     import jax.numpy as jnp
     from flax import linen as nn
 
-    from dtc_tpu.config.schema import MeshConfig, ModelConfig, OptimConfig, TrainConfig
+    from dtc_tpu.config.schema import MeshConfig, OptimConfig, TrainConfig
     from dtc_tpu.data.synthetic import synthetic_batch_iterator
     from dtc_tpu.models.gpt import GPT
     from dtc_tpu.parallel.mesh import mesh_from_config
@@ -33,14 +37,7 @@ def build_step(batch=32, heads=16, max_seq_len=512, dropout=0.1, remat=True,
     from dtc_tpu.train.train_step import Batch, create_train_step
     from dtc_tpu.train.trainer import init_state
 
-    model_cfg = ModelConfig(
-        vocab_size=50258, d_model=512, n_layers=12, n_heads=heads, d_ff=2048,
-        max_seq_len=max_seq_len, dropout=dropout, param_dtype="float32",
-        compute_dtype="bfloat16", attention="auto", remat=remat,
-        attention_block_q=block_q, attention_block_kv=block_kv,
-        attention_block_q_bwd=block_q_bwd, attention_block_kv_bwd=block_kv_bwd,
-        moe_experts=moe_experts,
-    )
+    model_cfg = flagship_model_cfg(**model_knobs)
     opt_cfg = OptimConfig(lr=3e-4, weight_decay=weight_decay, grad_clip=grad_clip)
     train_cfg = TrainConfig(
         seed=0, parallel="dp", batch=batch, steps=1, log_every=1, output_dir="",
@@ -54,7 +51,7 @@ def build_step(batch=32, heads=16, max_seq_len=512, dropout=0.1, remat=True,
         # train_step.state_shardings — without it GSPMD layout churn pays
         # a second identical cold compile on the call after warmup step 1).
         step_fn = create_train_step(mesh, model=model, state=state)
-    tok = next(synthetic_batch_iterator(batch, max_seq_len + 1, model_cfg.vocab_size))
+    tok = next(synthetic_batch_iterator(batch, model_cfg.max_seq_len + 1, model_cfg.vocab_size))
     batch_obj = Batch(x=jnp.asarray(tok[:, :-1]), y=jnp.asarray(tok[:, 1:]))
     key = jax.random.key(0, impl="rbg")
     return step_fn, state, batch_obj, key, (mesh, DEFAULT_RULES), model_cfg

@@ -21,7 +21,7 @@ sys.path.insert(0, REPO)
 def run(batch: int, heads: int, steps: int, trace_dir: str, remat: bool,
         seq: int = 512, block_q: int = 512, block_kv: int = 512,
         block_q_bwd: int = 0, block_kv_bwd: int = 0,
-        moe_experts: int = 0) -> float:
+        moe_experts: int = 0, moe_dispatch: str = "einsum") -> float:
     from bench_common import time_step
 
     # Trace `steps` iterations (trace size), but always time the full
@@ -31,7 +31,7 @@ def run(batch: int, heads: int, steps: int, trace_dir: str, remat: bool,
         batch=batch, heads=heads, remat=remat, max_seq_len=seq,
         block_q=block_q, block_kv=block_kv,
         block_q_bwd=block_q_bwd, block_kv_bwd=block_kv_bwd,
-        moe_experts=moe_experts,
+        moe_experts=moe_experts, moe_dispatch=moe_dispatch,
     )
 
 
@@ -75,6 +75,8 @@ if __name__ == "__main__":
     ap.add_argument("--block-kv-bwd", type=int, default=0)
     ap.add_argument("--heads", type=int, default=16)
     ap.add_argument("--moe-experts", type=int, default=0)
+    ap.add_argument("--moe-dispatch", default="einsum",
+                    choices=["einsum", "sort"])
     ap.add_argument("--steps", type=int, default=6)
     ap.add_argument("--top", type=int, default=40)
     ap.add_argument(
@@ -89,6 +91,7 @@ if __name__ == "__main__":
                   remat, seq=args.seq, block_q=args.block_q,
                   block_kv=args.block_kv, block_q_bwd=args.block_q_bwd,
                   block_kv_bwd=args.block_kv_bwd,
-                  moe_experts=args.moe_experts)
+                  moe_experts=args.moe_experts,
+                  moe_dispatch=args.moe_dispatch)
     print(f"# measured step time: {step_ms:.2f} ms")
     parse(args.trace_dir, args.steps, args.top)

@@ -10,9 +10,10 @@ defenses now exist:
 - the trainer refuses to truncate an existing log.csv on a fresh run
   unless ``overwrite: true`` (tested in test_checkpoint.py), and
 - this test cross-checks every ``outputs/<run>`` row of the README results
-  table against the committed CSV: the row count must be steps+1 (header
-  included) and the final loss must match the table to its printed
-  precision. If an artifact is clobbered again, this goes red.
+  table against the committed CSV: the DATA row count (header excluded —
+  the file itself has steps+1 lines) must equal the README's step count,
+  and the final loss must match the table to its printed precision. If an
+  artifact is clobbered again, this goes red.
 """
 
 from __future__ import annotations

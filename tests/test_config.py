@@ -57,6 +57,27 @@ def test_model_config_validation():
         ModelConfig(vocab_size=10, d_model=10, n_layers=1, n_heads=3, d_ff=4, max_seq_len=8)
 
 
+def test_attention_block_sizes_must_be_positive():
+    """Round-5 ADVICE: a negative block size used to pass
+    flash_attention.supports() (Python's modulo of a negative is
+    non-negative) and die deep inside pallas_call as an opaque Mosaic
+    error; config construction must reject it instead."""
+    base = dict(vocab_size=97, d_model=64, n_layers=2, n_heads=4, d_ff=128,
+                max_seq_len=32)
+    for kw in (
+        {"attention_block_q": -512},
+        {"attention_block_q": 0},
+        {"attention_block_kv": -128},
+        {"attention_block_q_bwd": -1},
+        {"attention_block_kv_bwd": -256},
+    ):
+        with pytest.raises(ValueError, match="attention_block"):
+            ModelConfig(**base, **kw)
+    # 0 stays legal for the bwd overrides: it means "same as forward".
+    cfg = ModelConfig(**base, attention_block_q_bwd=0, attention_block_kv_bwd=0)
+    assert cfg.attention_block_q_bwd == 0
+
+
 def test_resolve_mesh_shapes():
     m = MeshConfig()
     assert resolve_mesh_shape("dp", 8, m) == (1, 8, 1)

@@ -228,3 +228,40 @@ def test_packed_split_bwd_grad_parity(monkeypatch):
     for name, a, b in zip("qkv", g_fused, g_split):
         np.testing.assert_allclose(np.asarray(b), np.asarray(a), atol=2e-5,
                                    err_msg=f"d{name} split vs fused")
+
+
+def test_whole_t_tiles_past_packed_max_t_raise(monkeypatch):
+    """Guard-order regression (round-5 ADVICE): a tiling override that
+    resolves to one whole-T tile past _PACKED_MAX_T must be a clear
+    ValueError at the API surface — previously the single-tile fast path
+    was checked FIRST, so the fused kernel's full-T VMEM scratches hit an
+    opaque Mosaic compile OOM on TPU. Threshold shrunk so the guard fires
+    at a CPU-testable shape."""
+    import functools
+
+    import dtc_tpu.ops.flash_attention as fa
+
+    t, d, h = 256, 32, 8
+    q, k, v = _qkv(jax.random.PRNGKey(9), 1, t, h, d)
+    monkeypatch.setattr(fa, "_PACKED_MAX_T", 128)
+
+    # Forward tiling resolves to one whole-T tile.
+    with pytest.raises(ValueError, match="whole-T"):
+        flash_causal_attention(q, k, v, block_q=t, block_kv=t)
+    # Forward tiled fine, but the BACKWARD override is whole-T.
+    with pytest.raises(ValueError, match="whole-T"):
+        flash_causal_attention(q, k, v, block_q=128, block_kv=128,
+                               block_q_bwd=t, block_kv_bwd=t)
+    # Defense inside the vjp rule itself (direct _flash_packed callers
+    # bypass the API validation): same clear error, not a kernel launch.
+    g = fa._packed_group(d, h)
+    pk = lambda x: x.reshape(1, t, h * d)
+    lse = jnp.zeros((1, h * d // fa._LANES, t, g), jnp.float32)
+    with pytest.raises(ValueError, match="whole-T"):
+        fa._packed_flash_bwd(
+            t, t, g, d, float(d ** -0.5), 0, 0,
+            (pk(q), pk(k), pk(v), pk(q), lse), pk(q),
+        )
+    # Multi-tile tilings still route to the split backward and train.
+    out = flash_causal_attention(q, k, v, block_q=128, block_kv=128)
+    assert out.shape == q.shape

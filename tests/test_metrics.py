@@ -10,6 +10,7 @@ from dtc_tpu.utils.metrics import (
     gpt_step_flops,
     mfu,
     moe_step_flops,
+    moe_step_flops_useful,
     peak_flops_per_chip,
 )
 
@@ -55,15 +56,69 @@ def test_moe_step_flops_hand_computed():
     head = 2 * D + (D * PAD_V + PAD_V)
     n = embed + L * per_block + head
     n_matmul = n - PAD_V * D - T * D
-    n_moe = L * (D * e + e * 2 * D * FF)
+    # Subtracted MoE block = the FULL per-layer MoE params incl. the
+    # per-expert biases (the round-5 ADVICE bias omission), so this term
+    # plus the structural term below lines up with param_count.
+    n_moe = L * (D * e + e * (2 * D * FF + FF + D))
     dense = 6.0 * (n_matmul - n_moe) * batch * T
     attn = 12.0 * L * batch * T**2 * D / 2.0
     per_layer = (
         2.0 * batch * T * D * e
         + 4.0 * batch * T * e * cap * D
-        + 4.0 * batch * e * cap * D * FF
+        + 2.0 * batch * e * cap * (2 * D * FF + FF + D)
     )
     assert moe_step_flops(cfg, batch, T) == pytest.approx(dense + attn + 3.0 * L * per_layer)
+
+
+def test_moe_bias_accounting_matches_param_count():
+    """The fix the round-5 ADVICE asked for, as an invariant: subtracting
+    the MoE block and adding it back structurally at cap·E = T·k (every
+    assignment gets a slot, no slack) must reproduce dense-6N accounting
+    over the SAME param tree — i.e. the subtracted block equals the MoE
+    params in param_count, biases included."""
+    from dtc_tpu.models.gpt import param_count
+
+    e, k = 4, 2
+    # capacity_factor 1.0 with E | T·k: cap·E == T·k exactly.
+    cfg = _cfg(moe_experts=e, moe_top_k=k, moe_capacity_factor=1.0)
+    batch = 8
+    n_matmul = param_count(cfg) - PAD_V * D - T * D
+    n_moe = L * (D * e + e * (2 * D * FF + FF + D))
+    # 6N over non-MoE matmul params + structural MoE at zero slack + attn
+    # + dispatch/combine einsums.
+    cap = T * k // e
+    expect = (
+        6.0 * (n_matmul - n_moe) * batch * T
+        + 12.0 * L * batch * T**2 * D / 2.0
+        + 3.0 * L * (
+            2.0 * batch * T * D * e
+            + 4.0 * batch * T * e * cap * D
+            + 6.0 / 3.0 * batch * T * k * (2 * D * FF + FF + D)
+        )
+    )
+    assert moe_step_flops(cfg, batch, T) == pytest.approx(expect)
+
+
+def test_moe_useful_flops_below_hardware_basis():
+    """The useful basis drops capacity slack and the dispatch/combine
+    einsums: strictly less than the hardware basis whenever cf > 1, and
+    equal to dense-minus-FFN + router + k·T-token FFN by hand."""
+    e, k = 4, 2
+    cfg = _cfg(moe_experts=e, moe_top_k=k, moe_capacity_factor=1.25)
+    batch = 8
+    useful = moe_step_flops_useful(cfg, batch, T)
+    assert useful < moe_step_flops(cfg, batch, T)
+    n_moe = L * (D * e + e * (2 * D * FF + FF + D))
+    n_matmul = _dense_param_count() - PAD_V * D - T * D + n_moe - L * (
+        (D * FF + FF) + (FF * D + D)
+    )
+    dense = 6.0 * (n_matmul - n_moe) * batch * T
+    attn = 12.0 * L * batch * T**2 * D / 2.0
+    per_layer = (
+        2.0 * batch * T * D * e
+        + 2.0 * batch * T * k * (2 * D * FF + FF + D)
+    )
+    assert useful == pytest.approx(dense + attn + 3.0 * L * per_layer)
 
 
 def test_moe_flops_exceed_matched_dense_at_top2():

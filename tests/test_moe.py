@@ -2,10 +2,14 @@
 SURVEY §2.2 lists EP/MoE absent upstream).
 
 Dispatch correctness is pinned against a brute-force per-token reference
-loop, the E=1 degenerate case must equal a plain dense FFN, capacity
-overflow must drop (zero-contribute) tokens, EP sharding comes from the
-rule table, and the trainer must train end-to-end (aux loss included) on a
-DP x EP mesh.
+loop FOR BOTH dispatch backends (``moe_dispatch: einsum | sort``, see
+ops/moe_dispatch.py), the E=1 degenerate case must equal a plain dense
+FFN, capacity overflow must drop (zero-contribute) tokens, EP sharding
+comes from the rule table, and the trainer must train end-to-end (aux
+loss included) on a DP x EP mesh. The backends share one routing
+implementation; the cross-backend tests assert that contract from the
+outside: identical routing decisions at the router output, bitwise-equal
+aux loss, loss-parity training curves.
 """
 
 import dataclasses
@@ -62,19 +66,91 @@ def _reference_moe(params, x, cfg, cap):
     return out
 
 
+@pytest.mark.parametrize("dispatch", ["einsum", "sort"])
 @pytest.mark.parametrize("capacity_factor", [2.0, 0.4])
-def test_moe_matches_brute_force_reference(tiny_model_cfg, capacity_factor):
+def test_moe_matches_brute_force_reference(tiny_model_cfg, capacity_factor, dispatch):
     """cf=2.0: no overflow; cf=0.4 with k=2: experts overflow, so WHICH
-    assignments get dropped (choice-major order) is part of the contract."""
+    assignments get dropped (choice-major order) is part of the contract —
+    for BOTH dispatch backends."""
     from dtc_tpu.models.gpt import moe_capacity
 
     cfg = _moe_cfg(tiny_model_cfg, compute_dtype="float32",
-                   moe_capacity_factor=capacity_factor)
+                   moe_capacity_factor=capacity_factor, moe_dispatch=dispatch)
     mod, params, x = _init_moe(cfg, b=2, t=16)
     cap = moe_capacity(16, cfg)
     got = mod.apply({"params": params}, x)
     want = _reference_moe(params, x, cfg, cap)
     np.testing.assert_allclose(np.asarray(got), want, rtol=2e-4, atol=2e-5)
+
+
+@pytest.mark.parametrize("capacity_factor", [2.0, 0.6])
+def test_sort_matches_einsum_outputs_grads_and_aux(tiny_model_cfg, capacity_factor):
+    """The dispatch switch is a pure execution-strategy A/B: same params,
+    same input -> same output (fp-roundoff tolerance: the k gate-weighted
+    contributions sum in a different order), BITWISE-equal aux loss, and
+    matching parameter gradients — including through the capacity-drop
+    regime, where the two backends must drop the exact same assignments."""
+    cfg_e = _moe_cfg(tiny_model_cfg, compute_dtype="float32",
+                     moe_capacity_factor=capacity_factor)
+    cfg_s = dataclasses.replace(cfg_e, moe_dispatch="sort")
+    mod, params, x = _init_moe(cfg_e, b=2, t=16)
+    y_e, mut_e = mod.apply({"params": params}, x, mutable=["aux_loss"])
+    y_s, mut_s = MoEMLP(cfg_s).apply({"params": params}, x, mutable=["aux_loss"])
+    np.testing.assert_allclose(np.asarray(y_s), np.asarray(y_e),
+                               rtol=1e-6, atol=1e-6)
+    aux_e = np.asarray(jax.tree.leaves(mut_e["aux_loss"])[0])
+    aux_s = np.asarray(jax.tree.leaves(mut_s["aux_loss"])[0])
+    np.testing.assert_array_equal(aux_s, aux_e)  # shared routing: bitwise
+
+    def loss(p, cfg):
+        return jnp.sum(MoEMLP(cfg).apply({"params": p}, x) ** 2)
+
+    g_e = jax.grad(loss)(params, cfg_e)
+    g_s = jax.grad(loss)(params, cfg_s)
+    for (path, a), b in zip(
+        jax.tree_util.tree_leaves_with_path(g_e), jax.tree.leaves(g_s)
+    ):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                   rtol=2e-5, atol=2e-6,
+                                   err_msg=jax.tree_util.keystr(path))
+
+
+def test_routing_decisions_identical_across_backends(tiny_model_cfg):
+    """The contract the config switch rests on, asserted at the router
+    output: both backends consume ONE Routing (same expert ids, same slot
+    positions, same keep mask) and the permutation encodings agree —
+    slot_to_token (sort) is the transpose of the dispatch one-hots
+    (einsum)."""
+    from dtc_tpu.models.gpt import moe_capacity
+    from dtc_tpu.ops import moe_dispatch as md
+
+    cfg = _moe_cfg(tiny_model_cfg, compute_dtype="float32",
+                   moe_capacity_factor=0.6)
+    mod, params, x = _init_moe(cfg, b=2, t=16)
+    cap = moe_capacity(16, cfg)
+    logits = x @ params["router"]["kernel"]
+    r = md.top_k_routing(jax.nn.softmax(logits, axis=-1), cfg.moe_top_k, cap)
+
+    dispatch, combine = md.dispatch_combine_tensors(r, cap)
+    src, filled = md.slot_to_token(r, cap)
+    b, t, e = r.probs.shape
+    disp = np.asarray(dispatch)
+    src_n, filled_n = np.asarray(src).reshape(b, e, cap), np.asarray(filled)
+    for bi in range(b):
+        for ei in range(e):
+            for c in range(cap):
+                col = disp[bi, :, ei, c]
+                if filled_n[bi, ei, c]:
+                    # Exactly one token routed into this slot, and the
+                    # sort backend's slot map names the same token.
+                    assert col.sum() == 1.0
+                    assert col[src_n[bi, ei, c]] == 1.0
+                else:
+                    assert col.sum() == 0.0
+    # Combine weights are the gates of kept assignments only.
+    np.testing.assert_allclose(
+        np.asarray(combine).sum(axis=(2, 3)),
+        np.asarray(jnp.sum(r.gates * r.keep, axis=-1)), rtol=1e-6)
 
 
 def test_single_expert_equals_dense_ffn(tiny_model_cfg):
@@ -128,10 +204,12 @@ def test_ep_param_specs(tiny_model_cfg):
     assert n == param_count(cfg)
 
 
-def test_moe_trains_and_learns(tiny_model_cfg, opt_cfg, train_cfg_factory):
+@pytest.mark.parametrize("dispatch", ["einsum", "sort"])
+def test_moe_trains_and_learns(tiny_model_cfg, opt_cfg, train_cfg_factory, dispatch):
     """End-to-end on a DP x EP mesh (experts sharded over model=2): loss
-    must drop on the learnable synthetic stream and stay finite."""
-    cfg = _moe_cfg(tiny_model_cfg)
+    must drop on the learnable synthetic stream and stay finite — both
+    dispatch backends."""
+    cfg = _moe_cfg(tiny_model_cfg, moe_dispatch=dispatch)
     tc = train_cfg_factory(
         "3d", steps=8, log_every=1, mesh=MeshConfig(pipe=1, data=4, model=2)
     )
@@ -140,12 +218,35 @@ def test_moe_trains_and_learns(tiny_model_cfg, opt_cfg, train_cfg_factory):
     assert res.losses[-1] < res.losses[0], "MoE run failed to learn"
 
 
-def test_moe_under_pipeline_matches_dp_at_m1(tiny_model_cfg, opt_cfg, train_cfg_factory):
+def test_sort_dispatch_trains_loss_parity_with_einsum(
+    tiny_model_cfg, opt_cfg, train_cfg_factory
+):
+    """The A/B's correctness leg: a sort-dispatch run must reproduce the
+    einsum run's loss curve to golden-class tolerance — same seed, same
+    stream, same routing — on both a plain DP mesh and the DP x EP mesh
+    (where the collectives differ too, tests/test_collectives_hlo.py)."""
+    cfg_e = _moe_cfg(tiny_model_cfg)
+    cfg_s = _moe_cfg(tiny_model_cfg, moe_dispatch="sort")
+    dp_kw = dict(steps=5, log_every=1)
+    r_e = train(train_cfg_factory("dp", **dp_kw), cfg_e, opt_cfg)
+    r_s = train(train_cfg_factory("dp", **dp_kw), cfg_s, opt_cfg)
+    np.testing.assert_allclose(r_s.losses, r_e.losses, rtol=5e-5, atol=5e-5)
+
+    ep_kw = dict(steps=3, log_every=1, mesh=MeshConfig(pipe=1, data=4, model=2))
+    e_e = train(train_cfg_factory("3d", **ep_kw), cfg_e, opt_cfg)
+    e_s = train(train_cfg_factory("3d", **ep_kw), cfg_s, opt_cfg)
+    np.testing.assert_allclose(e_s.losses, e_e.losses, rtol=5e-5, atol=5e-5)
+
+
+@pytest.mark.parametrize("dispatch", ["einsum", "sort"])
+def test_moe_under_pipeline_matches_dp_at_m1(tiny_model_cfg, opt_cfg,
+                                             train_cfg_factory, dispatch):
     """PP x EP: with one microbatch the pipeline's per-stage aux sum equals
     the GSPMD step's full-batch aux exactly, so losses must match a DP run
     (with M > 1 the aux is a mean over microbatch-local statistics — a
-    different, equally valid estimator)."""
-    cfg = _moe_cfg(tiny_model_cfg)
+    different, equally valid estimator). Both dispatch backends must
+    compose with the pipeline's partially-manual region."""
+    cfg = _moe_cfg(tiny_model_cfg, moe_dispatch=dispatch)
     dp = train(train_cfg_factory("dp", steps=3, log_every=1), cfg, opt_cfg)
     pp = train(
         train_cfg_factory(
@@ -157,10 +258,13 @@ def test_moe_under_pipeline_matches_dp_at_m1(tiny_model_cfg, opt_cfg, train_cfg_
     np.testing.assert_allclose(pp.losses, dp.losses, rtol=5e-4, atol=5e-4)
 
 
-def test_moe_under_pipeline_1f1b_matches_gpipe(tiny_model_cfg, opt_cfg, train_cfg_factory):
+@pytest.mark.parametrize("dispatch", ["einsum", "sort"])
+def test_moe_under_pipeline_1f1b_matches_gpipe(tiny_model_cfg, opt_cfg,
+                                               train_cfg_factory, dispatch):
     """Both pipeline schedules thread the MoE aux loss (GPipe: through the
-    clock scan; 1F1B: explicit vjp seed) — they must agree."""
-    cfg = _moe_cfg(tiny_model_cfg)
+    clock scan; 1F1B: explicit vjp seed) — they must agree, for both
+    dispatch backends."""
+    cfg = _moe_cfg(tiny_model_cfg, moe_dispatch=dispatch)
     kw = dict(steps=3, log_every=1, pp_microbatches=2,
               mesh=MeshConfig(pipe=2, data=2, model=2))
     gp = train(train_cfg_factory("3d", **kw), cfg, opt_cfg)
@@ -175,15 +279,19 @@ def test_moe_config_validation():
         ModelConfig(**base, moe_experts=2, moe_top_k=3)
     with pytest.raises(ValueError, match="moe_experts"):
         ModelConfig(**base, moe_experts=-1)
+    with pytest.raises(ValueError, match="moe_dispatch"):
+        ModelConfig(**base, moe_experts=2, moe_dispatch="radix")
 
 
-def test_moe_decode_matches_full_forward(tiny_model_cfg):
+@pytest.mark.parametrize("dispatch", ["einsum", "sort"])
+def test_moe_decode_matches_full_forward(tiny_model_cfg, dispatch):
     """KV-cache decode works with MoE blocks (per-token routing, capacity
     ceil(k*cf/E) >= 1): cached greedy generation must equal the no-cache
-    full-forward oracle."""
+    full-forward oracle — both dispatch backends."""
     from dtc_tpu.generate import generate
 
-    cfg = _moe_cfg(tiny_model_cfg, compute_dtype="float32")
+    cfg = _moe_cfg(tiny_model_cfg, compute_dtype="float32",
+                   moe_dispatch=dispatch)
     model = GPT(cfg)
     x = jnp.ones((2, 4), jnp.int32)
     params = model.init({"params": jax.random.PRNGKey(7)}, x, train=False)["params"]
