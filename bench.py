@@ -169,11 +169,30 @@ def run_config(
     return res
 
 
-def decode_bench(batch: int = 8, prompt_len: int = 32, new_tokens: int = 128) -> dict:
+def decode_bench(
+    batch: int = 8,
+    prompt_len: int = 32,
+    new_tokens: int = 128,
+    decode_attention: str = "fused",
+) -> dict:
     """KV-cache autoregressive decode throughput on the flagship model —
-    a beyond-reference surface (the reference trains and plots only;
-    SURVEY §1 lists no sampling path). Random params: decode cost is
-    shape-, not value-, dependent."""
+    the serving surface (the reference trains and plots only; SURVEY §1
+    lists no sampling path). Random params: decode cost is shape-, not
+    value-, dependent.
+
+    ``decode_attention`` selects the per-layer attention backend
+    (``fused`` = the single-launch Pallas kernel, ``xla`` = the oracle) —
+    the A/B that isolates the kernel's contribution to ms/token. Every
+    row carries the memory-bandwidth roofline for its shape
+    (utils/metrics.decode_roofline_ms at the run's MEAN cache length) and
+    ``pct_of_roofline`` = floor/measured, so the serving numbers are
+    always read against the same floor PERF.md derives.
+
+    ``ms_per_token`` is decode-scan-only (a timed prefill-only leg is
+    subtracted, so the prompt-length A/B measures cache-length
+    sensitivity, not prefill size); ``wall_s``/``tokens_per_sec`` stay
+    end-to-end, the serving-shaped throughput.
+    """
     import time
 
     import jax
@@ -183,11 +202,13 @@ def decode_bench(batch: int = 8, prompt_len: int = 32, new_tokens: int = 128) ->
     from dtc_tpu.config.schema import ModelConfig
     from dtc_tpu.generate import generate
     from dtc_tpu.models.gpt import GPT
+    from dtc_tpu.utils.metrics import decode_roofline_ms
 
     model_cfg = ModelConfig(
         **FLAGSHIP_DIMS, n_heads=16,
         max_seq_len=512, dropout=0.0, param_dtype="float32",
         compute_dtype="bfloat16", attention="auto",
+        decode_attention=decode_attention,
     )
     model = GPT(model_cfg)
     x = jnp.ones((batch, 1), jnp.int32)
@@ -197,20 +218,81 @@ def decode_bench(batch: int = 8, prompt_len: int = 32, new_tokens: int = 128) ->
     )
     out = generate(model, params, prompt, new_tokens)  # compile
     np.asarray(out)
-    best = float("inf")
+    # Prefill-only leg: max_new_tokens=1 returns before the token scan,
+    # so best - best_prefill isolates the scan and ms_per_token measures
+    # the decode kernel, not prompt processing — otherwise the p256 row's
+    # 8x-larger prefill would masquerade as cache-length sensitivity.
+    np.asarray(generate(model, params, prompt, 1))  # compile
+    best = best_prefill = float("inf")
     for _ in range(3):
         t0 = time.perf_counter()
         out = generate(model, params, prompt, new_tokens)
         np.asarray(out)  # sync by value fetch (tunnel-safe)
         best = min(best, time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        np.asarray(generate(model, params, prompt, 1))
+        best_prefill = min(best_prefill, time.perf_counter() - t0)
+    decode_s = max(best - best_prefill, 0.0)
+    ms_per_token = decode_s / max(new_tokens - 1, 1) * 1e3
+    # Roofline at the mean write frontier over the measured run; a decode
+    # "token" here is one STEP of the whole batch, matching ms_per_token.
+    floor_ms = decode_roofline_ms(
+        model_cfg, batch, prompt_len + new_tokens // 2
+    )
     return {
         "batch": batch,
         "prompt_len": prompt_len,
         "new_tokens": new_tokens,
+        "decode_attention": decode_attention,
         "wall_s": round(best, 4),
+        "prefill_s": round(best_prefill, 4),
         "tokens_per_sec": round(batch * new_tokens / best, 1),
-        "ms_per_token": round(best / new_tokens * 1e3, 3),
+        "ms_per_token": round(ms_per_token, 3),
+        "roofline_ms_per_token": round(floor_ms, 4),
+        "pct_of_roofline": round(floor_ms / ms_per_token, 4),
     }
+
+
+def decode_drift_guard(extra: dict, repo_dir: str | None = None) -> list[str]:
+    """Compare this run's decode rows against the newest committed
+    ``BENCH_r*.json`` and flag any ms/token regression > 20% — the same
+    drift discipline the training rows get from round-over-round BENCH
+    comparison, applied automatically so a serving regression cannot ship
+    silently inside an otherwise-green bench. Returns human-readable
+    flag strings (also stored under ``extra["decode_regressions"]``)."""
+    import glob
+    import os
+    import re
+
+    repo_dir = repo_dir or os.path.dirname(os.path.abspath(__file__))
+    paths = sorted(glob.glob(os.path.join(repo_dir, "BENCH_r*.json")))
+    flags: list[str] = []
+    if not paths:
+        return flags
+    try:
+        with open(paths[-1]) as f:
+            prev_raw = json.load(f)
+        # The committed files wrap the run: the detail dict lives on the
+        # "# bench-detail:" line inside "tail".
+        m = re.search(r"# bench-detail: (\{.*\})", prev_raw.get("tail", ""))
+        prev = json.loads(m.group(1)) if m else {}
+    except (OSError, ValueError):
+        return flags
+    for label, row in extra.items():
+        if not (isinstance(row, dict) and label.startswith("decode")):
+            continue
+        old = prev.get(label)
+        if not (isinstance(old, dict) and "ms_per_token" in old):
+            continue
+        new_ms, old_ms = row.get("ms_per_token"), old["ms_per_token"]
+        if new_ms and old_ms and new_ms > 1.2 * old_ms:
+            flags.append(
+                f"{label}: {new_ms} ms/token vs {old_ms} in "
+                f"{os.path.basename(paths[-1])} (+{(new_ms / old_ms - 1) * 100:.0f}%)"
+            )
+    if flags:
+        extra["decode_regressions"] = flags
+    return flags
 
 
 def ring_block_smoke() -> dict:
@@ -376,7 +458,19 @@ def main() -> None:
         "vs_baseline": round(ref["tokens_per_sec"] / BASELINE_TOKENS_PER_SEC, 3),
     }
     print(json.dumps(result))
+    # Decode (serving) rows: b8 kept for round-over-round continuity, the
+    # batch sweep amortizes the weight read (Pope et al.'s lever — the
+    # roofline says b64 costs ~1.5x b8 per step for 8x the tokens), the
+    # xla row is the fused-kernel A/B oracle, and the p256 row is the
+    # prompt-length leg (cache_len sensitivity: mean write frontier 320
+    # vs the p32 row's 96 — 3.3x the KV read through the same kernel).
     emit("decode_b8", _safe("decode_b8", decode_bench))
+    emit("decode_b8_xla", _safe("decode_b8_xla", lambda: decode_bench(
+        decode_attention="xla")))
+    emit("decode_b32", _safe("decode_b32", lambda: decode_bench(batch=32)))
+    emit("decode_b64", _safe("decode_b64", lambda: decode_bench(batch=64)))
+    emit("decode_b8_p256", _safe("decode_b8_p256", lambda: decode_bench(
+        prompt_len=256, new_tokens=128)))
     emit("ring_block_smoke", _safe("ring_block_smoke", ring_block_smoke))
 
     # Assemble the detail line FROM the registry's event stream: each
@@ -398,6 +492,8 @@ def main() -> None:
     from dtc_tpu.obs import peak_hbm_bytes, sample_memory
 
     extra["peak_hbm_bytes"] = peak_hbm_bytes(sample_memory())
+    for flag in decode_drift_guard(extra):
+        print(f"# DECODE REGRESSION: {flag}")
     print("# bench-detail:", json.dumps(extra))
     reg.close()
 

@@ -69,6 +69,14 @@ class ModelConfig:
     # (bench.py MoE rows measure both; einsum stays default until the
     # on-chip A/B says otherwise, PERF.md).
     moe_dispatch: str = "einsum"
+    # Decode (KV-cache inference) attention backend: "fused" = ONE Pallas
+    # launch per layer per token on the packed (B, S, H·D) cache
+    # (ops/decode_attention.py — the serving fast path; falls back to xla
+    # automatically for multi-token prefill calls and unsupported cache
+    # lengths), "xla" = the einsum/softmax oracle (ops/attention.py
+    # decode_attention) kept as the parity reference — the two are
+    # token-exact on every test in tests/test_generate.py.
+    decode_attention: str = "fused"
     # Dev knob: emit checkify.check guards for traced invariants that
     # cannot raise at trace time (currently the decode-cache write
     # frontier, whose dynamic_update_slice would otherwise CLAMP on
@@ -100,6 +108,11 @@ class ModelConfig:
             raise ValueError(
                 f"unknown moe_dispatch {self.moe_dispatch!r}; "
                 "expected 'einsum' or 'sort'"
+            )
+        if self.decode_attention not in ("fused", "xla"):
+            raise ValueError(
+                f"unknown decode_attention {self.decode_attention!r}; "
+                "expected 'fused' or 'xla'"
             )
         # Block sizes must be positive HERE: a negative value slips through
         # flash_attention.supports() (Python modulo of negatives is

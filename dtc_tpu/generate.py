@@ -3,14 +3,21 @@
 A capability beyond the reference (which trains and plots, but cannot
 sample — SURVEY.md §1 lists no serve/inference path). Decode reuses the
 training model unchanged: ``decode=True`` threads a "cache" collection
-through the modules — each attention layer keeps ``(B, max_seq_len, H, D)``
-key/value buffers plus a write index, the embed keeps a position counter —
-so one prefill call consumes the whole prompt and each subsequent call
-appends one token at O(T) cost instead of re-running the full O(T²)
-forward per token.
+through the modules — each attention layer keeps packed
+``(B, max_seq_len, H·D)`` key/value buffers (the model-native lane
+layout the fused decode kernel reads directly, ops/decode_attention.py),
+and ONE model-level write-frontier/position counter lives at the GPT
+root — so one prefill call consumes the whole prompt and each subsequent
+call appends one token at O(T) cost instead of re-running the full O(T²)
+forward per token. ``cfg.decode_attention`` selects the per-layer
+attention backend: ``fused`` (single Pallas launch per layer — the
+serving fast path) or ``xla`` (the einsum/softmax parity oracle).
 
 The token loop is a ``lax.scan`` under one ``jax.jit``: no per-token
-Python dispatch, TPU-friendly static shapes throughout.
+Python dispatch, TPU-friendly static shapes throughout. Greedy decoding
+(``temperature == 0``) takes a fast path that skips the sampling
+machinery entirely — no per-token RNG splits ride the scan carry and the
+argmax never sees the top-k/top-p filters.
 """
 
 from __future__ import annotations
@@ -103,10 +110,16 @@ def _generate_impl(
     if rng is None:
         rng = jax.random.PRNGKey(0)  # unused by greedy
 
+    # ``greedy`` is a STATIC fact (temperature is a static argname), so
+    # the two loop bodies below compile to different programs: the greedy
+    # scan carries no RNG key and runs argmax only — none of the top-k /
+    # top-p / categorical machinery appears in its HLO.
+    greedy = temperature == 0.0
+
     def sample(logits_last: jax.Array, key: jax.Array) -> jax.Array:
         # Padded vocab columns carry -1e9 from the head mask, so neither
         # argmax nor categorical can pick them.
-        if temperature == 0.0:
+        if greedy:
             return jnp.argmax(logits_last, axis=-1).astype(jnp.int32)
         logits_last = logits_last.astype(jnp.float32) / temperature
         if top_k is not None:
@@ -125,21 +138,31 @@ def _generate_impl(
     rng, sub = jax.random.split(rng)
     first = sample(logits[:, -1], sub)
 
-    def body(carry, _):
-        cache, tok, key = carry
-        logits, mutated = model.apply(
+    def step_logits(cache, tok):
+        return model.apply(
             {"params": params, "cache": cache}, tok[:, None],
             train=False, decode=True, mutable=["cache"],
         )
-        key, sub = jax.random.split(key)
-        nxt = sample(logits[:, -1], sub)
-        return (mutated["cache"], nxt, key), nxt
+
+    if greedy:
+        def body(carry, _):
+            cache, tok = carry
+            logits, mutated = step_logits(cache, tok)
+            nxt = sample(logits[:, -1], None)
+            return (mutated["cache"], nxt), nxt
+        init = (mutated["cache"], first)
+    else:
+        def body(carry, _):
+            cache, tok, key = carry
+            logits, mutated = step_logits(cache, tok)
+            key, sub = jax.random.split(key)
+            nxt = sample(logits[:, -1], sub)
+            return (mutated["cache"], nxt, key), nxt
+        init = (mutated["cache"], first, rng)
 
     if max_new_tokens == 1:
         return first[:, None]
-    (_, _, _), rest = jax.lax.scan(
-        body, (mutated["cache"], first, rng), None, length=max_new_tokens - 1
-    )
+    _, rest = jax.lax.scan(body, init, None, length=max_new_tokens - 1)
     return jnp.concatenate([first[:, None], rest.T], axis=1)
 
 

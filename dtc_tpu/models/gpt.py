@@ -49,7 +49,14 @@ class CausalSelfAttention(nn.Module):
     cfg: ModelConfig
 
     @nn.compact
-    def __call__(self, x: jax.Array, *, train: bool, decode: bool = False) -> jax.Array:
+    def __call__(
+        self,
+        x: jax.Array,
+        *,
+        train: bool,
+        decode: bool = False,
+        decode_index: jax.Array | None = None,
+    ) -> jax.Array:
         cfg = self.cfg
         b, t, _ = x.shape
         cdtype = _dtype(cfg.compute_dtype)
@@ -65,52 +72,75 @@ class CausalSelfAttention(nn.Module):
         if decode:
             # Autoregressive KV-cache path (inference; single device or
             # GSPMD — no flash/ring). The cache holds max_seq_len k/v per
-            # layer; ``index`` is the write frontier shared with the
-            # embed's position counter by construction (both advance by t
-            # per call). CALLER CONTRACT: total decoded length must stay
-            # <= max_seq_len — past it, dynamic_update_slice CLAMPS the
-            # write start and logits go silently wrong (the index is
-            # traced, so this cannot raise here; dtc_tpu.generate.generate
-            # enforces the bound at its static API surface).
+            # layer in the PACKED model-native (B, S, H·D) layout — the
+            # raw byte order of the k/v projections, so the write below is
+            # a lane-aligned in-place dynamic_update_slice with no
+            # relayout, and the fused decode kernel reads it directly.
+            # ``decode_index`` is the write frontier, owned by GPT (one
+            # scalar per model, not one per layer — the scan body carries
+            # it, it never updates inside the loop). CALLER CONTRACT:
+            # total decoded length must stay <= max_seq_len — past it,
+            # dynamic_update_slice CLAMPS the write start and logits go
+            # silently wrong (the index is traced, so this cannot raise
+            # here; GPT.__call__ emits the checkify guard under
+            # cfg.debug_checks and dtc_tpu.generate.generate enforces the
+            # bound at its static API surface).
             from dtc_tpu.ops.attention import decode_attention
+            from dtc_tpu.ops import decode_attention as fused
 
+            if decode_index is None:
+                # ValueError, not assert: must fire under `python -O` too
+                # (same rationale as parallel/pipeline.py's stage check).
+                raise ValueError(
+                    "decode=True requires the GPT-owned decode_index (apply "
+                    "the full GPT model, not a bare stage, for decode)"
+                )
+            idx = decode_index
+            hd = cfg.n_heads * cfg.head_dim
             ck = self.variable(
-                "cache", "k", jnp.zeros,
-                (b, cfg.max_seq_len, cfg.n_heads, cfg.head_dim), cdtype,
+                "cache", "k", jnp.zeros, (b, cfg.max_seq_len, hd), cdtype,
             )
             cv = self.variable(
-                "cache", "v", jnp.zeros,
-                (b, cfg.max_seq_len, cfg.n_heads, cfg.head_dim), cdtype,
+                "cache", "v", jnp.zeros, (b, cfg.max_seq_len, hd), cdtype,
             )
-            ci = self.variable("cache", "index", lambda: jnp.zeros((), jnp.int32))
-            idx = ci.value
-            if cfg.debug_checks:
-                # The caller contract above, enforced dynamically: callers
-                # bypassing generate() can discharge this via
-                # checkify.checkify instead of debugging clamped writes.
-                from jax.experimental import checkify
-
-                checkify.check(
-                    idx + t <= cfg.max_seq_len,
-                    "decode cache overflow: write frontier {i} + {n} tokens "
-                    "exceeds max_seq_len={m}; dynamic_update_slice would "
-                    "clamp and corrupt the cache",
-                    i=idx, n=jnp.int32(t), m=jnp.int32(cfg.max_seq_len),
-                )
             # Logical constraints shard the cache over heads under a TP
-            # mesh (seq stays unsharded, so the dynamic update partitions
-            # trivially); decode then runs head-parallel up to out_proj's
-            # all-reduce, same as training.
+            # mesh (the packed lane axis IS the head axis × head_dim, so
+            # sharding it over "model" is head sharding; seq stays
+            # unsharded and the dynamic update partitions trivially);
+            # decode then runs head-parallel up to out_proj's all-reduce,
+            # same as training.
             ck.value = nn.with_logical_constraint(
-                jax.lax.dynamic_update_slice(ck.value, k, (0, idx, 0, 0)),
-                ("batch", "seq", "heads", "head_dim"),
+                jax.lax.dynamic_update_slice(
+                    ck.value, k.reshape(b, t, hd), (0, idx, 0)
+                ),
+                ("batch", "seq", "heads"),
             )
             cv.value = nn.with_logical_constraint(
-                jax.lax.dynamic_update_slice(cv.value, v, (0, idx, 0, 0)),
-                ("batch", "seq", "heads", "head_dim"),
+                jax.lax.dynamic_update_slice(
+                    cv.value, v.reshape(b, t, hd), (0, idx, 0)
+                ),
+                ("batch", "seq", "heads"),
             )
-            ci.value = idx + t
-            out = decode_attention(q, ck.value, cv.value, idx)
+            if (
+                cfg.decode_attention == "fused"
+                and t == 1
+                and fused.supports(cfg.max_seq_len)
+            ):
+                # The serving fast path: one Pallas launch reads the whole
+                # packed cache, masked to the frontier. Multi-token calls
+                # (prefill — once per sequence) and unsupported cache
+                # lengths take the XLA oracle below.
+                out = fused.fused_decode_attention(
+                    q.reshape(b, 1, hd), ck.value, cv.value, idx,
+                    h=cfg.n_heads, d=cfg.head_dim,
+                ).reshape(b, 1, cfg.n_heads, cfg.head_dim)
+            else:
+                out = decode_attention(
+                    q,
+                    ck.value.reshape(b, cfg.max_seq_len, cfg.n_heads, cfg.head_dim),
+                    cv.value.reshape(b, cfg.max_seq_len, cfg.n_heads, cfg.head_dim),
+                    idx,
+                )
         else:
             # Head axis is the TP-sharded axis: under TP each device holds
             # n_heads / model_parallelism heads and attention is
@@ -254,7 +284,14 @@ class Block(nn.Module):
     cfg: ModelConfig
 
     @nn.compact
-    def __call__(self, x: jax.Array, *, train: bool, decode: bool = False) -> jax.Array:
+    def __call__(
+        self,
+        x: jax.Array,
+        *,
+        train: bool,
+        decode: bool = False,
+        decode_index: jax.Array | None = None,
+    ) -> jax.Array:
         cfg = self.cfg
 
         def ln(name):
@@ -263,7 +300,9 @@ class Block(nn.Module):
 
         h = ln("ln_1")(x).astype(_dtype(cfg.compute_dtype))
         x = x + nn.Dropout(cfg.dropout, deterministic=not train)(
-            CausalSelfAttention(cfg, name="attn")(h, train=train, decode=decode)
+            CausalSelfAttention(cfg, name="attn")(
+                h, train=train, decode=decode, decode_index=decode_index
+            )
         )
         h = ln("ln_2")(x).astype(_dtype(cfg.compute_dtype))
         if cfg.moe_experts > 0:
@@ -289,15 +328,24 @@ class Block(nn.Module):
 
 
 class _ScanBlock(nn.Module):
-    """Carry adapter so Block can run under nn.scan."""
+    """Carry adapter so Block can run under nn.scan. The carry is
+    ``(h, decode_index)`` — the decode write frontier rides along
+    UNCHANGED (None outside decode), so the scan body stays one fused
+    block per layer with no per-layer index variable or counter update
+    (the pre-hoist layout stacked an (L,) index in the cache collection
+    and re-incremented it in every layer's program)."""
 
     cfg: ModelConfig
     train: bool
     decode: bool = False
 
     @nn.compact
-    def __call__(self, h: jax.Array, _):
-        return Block(self.cfg)(h, train=self.train, decode=self.decode), None
+    def __call__(self, carry, _):
+        h, idx = carry
+        h = Block(self.cfg)(
+            h, train=self.train, decode=self.decode, decode_index=idx
+        )
+        return (h, idx), None
 
 
 class GPTEmbed(nn.Module):
@@ -325,13 +373,11 @@ class GPTEmbed(nn.Module):
         cfg = self.cfg
         pdtype = _dtype(cfg.param_dtype)
         _, t = x.shape
-        if decode:
-            # Position counter for autoregressive decode; advances in step
-            # with the attention layers' cache indices (both add t per
-            # call), so positions line up with cache slots.
-            pos_var = self.variable("cache", "pos", lambda: jnp.zeros((), jnp.int32))
-            pos_offset = pos_var.value
-            pos_var.value = pos_offset + t
+        # Decode position bookkeeping is GPT's: the single cache "index"
+        # counter doubles as the position offset (cache slots and
+        # positions advance in lockstep by construction), passed in via
+        # ``pos_offset`` — no per-module counters to keep in sync.
+        del decode
         wte = nn.Embed(cfg.padded_vocab_size, cfg.d_model, name="wte", param_dtype=pdtype)
         if self.lookup == "onehot":
             onehot = jax.nn.one_hot(x, cfg.padded_vocab_size, dtype=_dtype(cfg.compute_dtype))
@@ -364,7 +410,12 @@ class GPTStage(nn.Module):
 
     @nn.compact
     def __call__(
-        self, h: jax.Array, *, train: bool = True, decode: bool = False
+        self,
+        h: jax.Array,
+        *,
+        train: bool = True,
+        decode: bool = False,
+        decode_index: jax.Array | None = None,
     ) -> jax.Array:
         cls = _ScanBlock
         mode = self.cfg.remat_mode
@@ -390,7 +441,7 @@ class GPTStage(nn.Module):
             length=self.n_layers,
             metadata_params={nn.PARTITION_NAME: "layers"},
         )(self.cfg, train, decode, name="blocks")
-        h, _ = scanned(h, None)
+        (h, _), _ = scanned((h, decode_index), None)
         return h
 
 
@@ -449,11 +500,7 @@ class GPT(nn.Module):
 
     cfg: ModelConfig
 
-    def setup(self):
-        self.embed = GPTEmbed(self.cfg)
-        self.stage = GPTStage(self.cfg, self.cfg.n_layers)
-        self.head = GPTHead(self.cfg)
-
+    @nn.compact
     def __call__(
         self,
         x: jax.Array,
@@ -466,16 +513,48 @@ class GPT(nn.Module):
         the mean next-token CE loss via the fused head+CE op (the train
         step's path; one logits pass cheaper in backward, PERF.md round 4).
 
-        ``decode=True`` CALLER CONTRACT: the cumulative decoded length across
-        calls must stay <= ``cfg.max_seq_len``. The KV-cache write index is a
-        traced value, so it cannot be range-checked here; past the bound,
-        ``dynamic_update_slice`` clamps the write start and logits go silently
-        wrong. ``dtc_tpu.generate.generate`` enforces this at its static API
-        surface — callers applying the model directly must do the same.
+        ``decode=True``: GPT owns the ONE decode position/write-frontier
+        counter (``cache/index``) — updated here, outside the layer scan,
+        and threaded down read-only so the scan body is pure per-layer
+        compute (the per-layer stacked counters this replaced cost an
+        update op per layer per token). CALLER CONTRACT: the cumulative
+        decoded length across calls must stay <= ``cfg.max_seq_len``. The
+        write index is a traced value, so it cannot be range-checked here;
+        past the bound, ``dynamic_update_slice`` clamps the write start
+        and logits go silently wrong. ``dtc_tpu.generate.generate``
+        enforces this at its static API surface — callers applying the
+        model directly must do the same (or discharge the
+        ``cfg.debug_checks`` checkify guard below).
         """
-        h = self.embed(x, train=train, decode=decode)
-        h = self.stage(h, train=train, decode=decode)
-        return self.head(h, targets=targets)
+        cfg = self.cfg
+        idx = None
+        pos_offset: int | jax.Array = 0
+        if decode:
+            ci = self.variable("cache", "index", lambda: jnp.zeros((), jnp.int32))
+            idx = ci.value
+            if cfg.debug_checks:
+                # The caller contract above, enforced dynamically: callers
+                # bypassing generate() can discharge this via
+                # checkify.checkify instead of debugging clamped writes.
+                from jax.experimental import checkify
+
+                checkify.check(
+                    idx + x.shape[1] <= cfg.max_seq_len,
+                    "decode cache overflow: write frontier {i} + {n} tokens "
+                    "exceeds max_seq_len={m}; dynamic_update_slice would "
+                    "clamp and corrupt the cache",
+                    i=idx, n=jnp.int32(x.shape[1]),
+                    m=jnp.int32(cfg.max_seq_len),
+                )
+            ci.value = idx + x.shape[1]
+            pos_offset = idx
+        h = GPTEmbed(cfg, name="embed")(
+            x, train=train, decode=decode, pos_offset=pos_offset
+        )
+        h = GPTStage(cfg, cfg.n_layers, name="stage")(
+            h, train=train, decode=decode, decode_index=idx
+        )
+        return GPTHead(cfg, name="head")(h, targets=targets)
 
 
 def param_count(cfg: ModelConfig) -> int:

@@ -1,4 +1,4 @@
-from dtc_tpu.ops import moe_dispatch
+from dtc_tpu.ops import decode_attention, moe_dispatch
 from dtc_tpu.ops.attention import causal_attention
 
-__all__ = ["causal_attention", "moe_dispatch"]
+__all__ = ["causal_attention", "decode_attention", "moe_dispatch"]

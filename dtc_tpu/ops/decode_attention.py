@@ -1,0 +1,232 @@
+"""Fused single-query decode attention — one Pallas launch per layer.
+
+The decode step's attention used to be a pile of small XLA ops per layer
+(score einsum over the full cache, iota mask build, fp32 softmax, value
+einsum — each a separate kernel launch inside the token scan), which is
+what made decode launch-bound at ~4 ms/token (PERF.md round 5: an
+fp32-vs-bf16 weight A/B moved nothing, so the cost is dispatch, not
+bandwidth). This kernel folds the whole per-layer attention read into ONE
+launch over the model-native packed KV layout:
+
+- **Layout**: the cache is ``(B, S, H·D)`` — exactly the byte layout the
+  qkv projections produce and the packed training kernels consume
+  (ops/flash_attention.py round 3). Heads group ``g`` per lane block
+  (``128 // D`` when that divides the head count; otherwise one block of
+  all ``H·D`` lanes — Mosaic pads internally, same as the transpose
+  kernels keep head_dim native). The per-head slice happens INSIDE VMEM,
+  a register shuffle, never an HBM pass.
+- **Masking**: the query is ONE new token at position ``start``; cache
+  columns ``col <= start`` are valid (the current token's k/v are written
+  at ``start`` before attention — models/gpt.py). ``start`` rides in as
+  an SMEM scalar so the mask is an in-register iota compare, and KV
+  blocks entirely beyond the frontier are predicated out (their compute
+  never runs; at S=512 the whole cache is one tile anyway).
+- **Numerics**: fp32 scores/softmax regardless of input dtype, the same
+  ``exp(s - max)`` one-pass softmax as the training kernels' single-tile
+  path — the XLA oracle (ops/attention.py ``decode_attention``) remains
+  the parity reference, asserted token-exact in tests/test_generate.py.
+
+The kernel handles ONLY the single-token step (``T_new == 1``); prefill
+(multi-token) goes through the oracle — it runs once per sequence, the
+scan body runs per token.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# Shared with the training kernels; importing flash_attention also installs
+# the jax-0.4.x pltpu.CompilerParams alias every pallas_call below relies on.
+from dtc_tpu.ops.flash_attention import _interpret, _packed_group
+
+NEG_INF = -1e9  # matches ops/attention.py
+_LANES = 128
+
+#: Longest cache held as ONE KV tile per (batch, group) program. The tile
+#: is (S, lane_block) in the input dtype — 2 MB bf16 at S=4096/128 lanes,
+#: comfortably VMEM — and a single tile needs no online-softmax scratch.
+#: Past this the blocked kernel walks the cache in _DECODE_BLOCK_S chunks
+#: and skips the compute for blocks beyond the write frontier (Pallas
+#: still pipelines every block's copy — the skip saves VPU/MXU work,
+#: not HBM reads).
+_DECODE_MAX_SINGLE_S = 4096
+_DECODE_BLOCK_S = 512
+
+
+def _group(d: int, h: int) -> tuple[int, int]:
+    """(heads per lane block, lane block width).
+
+    128-lane groups per the training kernels' packed grouping rule
+    (flash_attention._packed_group, shared so the two paths can't
+    diverge); otherwise one block holding all H·D lanes — correct for
+    any shape (the tiny CPU-test models), lane-padded by Mosaic."""
+    g = _packed_group(d, h)
+    return (g, _LANES) if g is not None else (h, h * d)
+
+
+def supports(s: int) -> bool:
+    """Whether the fused kernel handles a cache of length ``s``."""
+    return s <= _DECODE_MAX_SINGLE_S or s % _DECODE_BLOCK_S == 0
+
+
+def _decode_kernel_single(start_ref, q_ref, k_ref, v_ref, o_ref, *,
+                          s, g, d, scale):
+    """Whole-cache-in-one-tile decode step for the g heads of this lane
+    block: per head, a (1, S) score row, masked to the frontier, one-pass
+    softmax, and a (1, D) output row. No scratch, no rescale passes."""
+    start = start_ref[0]
+    qt = q_ref[0]                                  # (1, g*d)
+    kt, vt = k_ref[0], v_ref[0]                    # (s, g*d)
+    col = jax.lax.broadcasted_iota(jnp.int32, (1, s), 1)
+    mask = col <= start
+    for gg in range(g):
+        sl = slice(gg * d, (gg + 1) * d)
+        sc = jax.lax.dot_general(
+            qt[:, sl] * scale, kt[:, sl], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )                                           # (1, s) fp32
+        sc = jnp.where(mask, sc, NEG_INF)
+        m = jnp.max(sc, axis=-1, keepdims=True)
+        p = jnp.exp(sc - m)
+        l = jnp.sum(p, axis=-1, keepdims=True)
+        acc = jax.lax.dot_general(
+            p.astype(vt.dtype), vt[:, sl], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )                                           # (1, d)
+        o_ref[0, :, sl] = (acc / l).astype(o_ref.dtype)
+
+
+def _decode_kernel_blocked(start_ref, q_ref, k_ref, v_ref, o_ref,
+                           m_scr, l_scr, acc_scr, *, block_s, g, d, scale):
+    """Online-softmax decode step over KV blocks (caches past the
+    single-tile bound). Blocks whose first column is beyond the write
+    frontier are predicated out — a 32k-slot cache decoded at position
+    600 COMPUTES two blocks, not 64, though the pipeline still copies in
+    all 64 (compute skip, not a DMA skip). Scratch rows 0
+    hold head gg's running stats in column gg (the packed-kernel
+    convention); the output is written once at the last block."""
+    j = pl.program_id(2)
+    start = start_ref[0]
+
+    @pl.when(j == 0)
+    def _():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    @pl.when(j * block_s <= start)
+    def _():
+        qt = q_ref[0]                              # (1, g*d)
+        kt, vt = k_ref[0], v_ref[0]                # (block_s, g*d)
+        col = j * block_s + jax.lax.broadcasted_iota(
+            jnp.int32, (1, block_s), 1
+        )
+        mask = col <= start
+        for gg in range(g):
+            sl = slice(gg * d, (gg + 1) * d)
+            cl = slice(gg, gg + 1)
+            sc = jax.lax.dot_general(
+                qt[:, sl] * scale, kt[:, sl], (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            sc = jnp.where(mask, sc, NEG_INF)
+            m_prev = m_scr[:1, cl]
+            m_new = jnp.maximum(m_prev, jnp.max(sc, axis=-1, keepdims=True))
+            alpha = jnp.exp(m_prev - m_new)
+            p = jnp.exp(sc - m_new)
+            l_scr[:1, cl] = alpha * l_scr[:1, cl] + jnp.sum(
+                p, axis=-1, keepdims=True
+            )
+            acc_scr[:1, sl] = acc_scr[:1, sl] * alpha + jax.lax.dot_general(
+                p.astype(vt.dtype), vt[:, sl], (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            m_scr[:1, cl] = m_new
+
+    @pl.when(j == pl.num_programs(2) - 1)
+    def _():
+        for gg in range(g):
+            sl = slice(gg * d, (gg + 1) * d)
+            cl = slice(gg, gg + 1)
+            o_ref[0, :, sl] = (acc_scr[:1, sl] / l_scr[:1, cl]).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("h", "d"))
+def fused_decode_attention(
+    q: jax.Array, k: jax.Array, v: jax.Array, start: jax.Array,
+    *, h: int, d: int,
+) -> jax.Array:
+    """Single-launch decode attention on the packed KV layout.
+
+    ``q`` is ``(B, 1, H·D)`` — the one new token, model-native packed;
+    ``k``/``v`` are the FULL cache ``(B, S, H·D)`` with valid columns
+    ``<= start`` (the scalar write frontier, the new token's position).
+    Returns ``(B, 1, H·D)`` in q's dtype. Numerics match
+    :func:`dtc_tpu.ops.attention.decode_attention` (fp32 softmax, -1e9
+    mask) to fp roundoff; token-level decisions are exact in practice and
+    asserted in tests/test_generate.py.
+    """
+    b, t, hd = q.shape
+    s = k.shape[1]
+    if t != 1:
+        raise ValueError(f"fused decode attention is single-query; got T={t}")
+    if hd != h * d:
+        raise ValueError(f"packed width {hd} != n_heads*head_dim {h}*{d}")
+    if not supports(s):
+        raise ValueError(
+            f"cache length {s} unsupported (> {_DECODE_MAX_SINGLE_S} and not "
+            f"a multiple of {_DECODE_BLOCK_S}); use the xla decode path"
+        )
+    g, lb = _group(d, h)
+    hg = hd // lb
+    scale = float(d ** -0.5)
+    start = jnp.asarray(start, jnp.int32).reshape((1,))
+
+    qspec = pl.BlockSpec((1, 1, lb), lambda bi, gi, *_: (bi, 0, gi))
+    sspec = pl.BlockSpec(memory_space=pltpu.SMEM)
+    if s <= _DECODE_MAX_SINGLE_S:
+        return pl.pallas_call(
+            functools.partial(
+                _decode_kernel_single, s=s, g=g, d=d, scale=scale
+            ),
+            grid=(b, hg),
+            in_specs=[
+                sspec,
+                pl.BlockSpec((1, 1, lb), lambda bi, gi: (bi, 0, gi)),
+                pl.BlockSpec((1, s, lb), lambda bi, gi: (bi, 0, gi)),
+                pl.BlockSpec((1, s, lb), lambda bi, gi: (bi, 0, gi)),
+            ],
+            out_specs=pl.BlockSpec((1, 1, lb), lambda bi, gi: (bi, 0, gi)),
+            out_shape=jax.ShapeDtypeStruct((b, 1, hd), q.dtype),
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=("parallel", "parallel"),
+            ),
+            interpret=_interpret(),
+        )(start, q, k, v)
+
+    nkv = s // _DECODE_BLOCK_S
+    kvspec = pl.BlockSpec((1, _DECODE_BLOCK_S, lb), lambda bi, gi, j: (bi, j, gi))
+    return pl.pallas_call(
+        functools.partial(
+            _decode_kernel_blocked, block_s=_DECODE_BLOCK_S, g=g, d=d,
+            scale=scale,
+        ),
+        grid=(b, hg, nkv),
+        in_specs=[sspec, qspec, kvspec, kvspec],
+        out_specs=qspec,
+        out_shape=jax.ShapeDtypeStruct((b, 1, hd), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((8, _LANES), jnp.float32),  # running max (row 0)
+            pltpu.VMEM((8, _LANES), jnp.float32),  # running sum (row 0)
+            pltpu.VMEM((8, lb), jnp.float32),      # output accumulator
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=_interpret(),
+    )(start, q, k, v)

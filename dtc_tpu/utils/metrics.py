@@ -125,6 +125,99 @@ def _dtype_bytes(dtype: str) -> int:
     return {"float32": 4, "bfloat16": 2, "float16": 2}.get(dtype, 4)
 
 
+#: Sustained HBM bandwidth per v5e chip (GB/s) — the denominator of the
+#: decode roofline. Peak is 819; we model the floor at peak (optimistic
+#: floor = honest "pct of roofline" ceiling).
+HBM_GBPS_V5E = 819.0
+
+
+def decode_step_flops(cfg: ModelConfig, batch: int, cache_len: int) -> float:
+    """Matmul FLOPs for ONE decode step (every sequence in the batch
+    appends one token; no backward).
+
+    2·N_matmul per token for the dense side (same N_matmul basis as
+    :func:`gpt_step_flops`: embedding gathers excluded, lm_head counted)
+    plus single-query attention: per layer one (1, cache_len)·head score
+    row and one value contraction — 4·cache_len·d_model FLOPs/layer/token.
+    Decode FLOPs are tiny (the flagship's ~0.13 GF/token is <0.001% of a
+    v5e-second); the step is bandwidth-bound, which is why the roofline
+    below is a byte model, not a FLOP model.
+    """
+    n = param_count(cfg)
+    n_matmul = n - cfg.padded_vocab_size * cfg.d_model - cfg.max_seq_len * cfg.d_model
+    dense = 2.0 * n_matmul * batch
+    attn = 4.0 * cfg.n_layers * batch * cache_len * cfg.d_model
+    return dense + attn
+
+
+def decode_step_bytes(
+    cfg: ModelConfig, batch: int, cache_len: int
+) -> dict[str, float]:
+    """Estimated HBM bytes moved by ONE decode step — the decode
+    roofline's numerator, by component:
+
+    - ``weights``: every matmul parameter read once per step in
+      ``param_dtype`` (batch amortizes this — THE reason wider decode
+      batches win; fp32 master weights make it 4 bytes/param: an
+      inference deployment would halve it by serving bf16 copies).
+    - ``kv_read``: both caches read up to the frontier per layer
+      (``cache_len`` columns) — the bandwidth-OPTIMAL traffic a
+      single-query step needs, which keeps this a true floor. Neither
+      current path achieves it: the XLA oracle and the single-tile fused
+      kernel read the full ``max_seq_len`` buffer, and the blocked
+      kernel's beyond-frontier skip predicates the compute only (the
+      pipeline still copies every block in), so measured pct-of-roofline
+      carries that slack on top of launch overhead.
+    - ``kv_write``: the new token's k/v appended per layer.
+    - ``activations``: residual stream + qkv/attn-out + the d_ff-wide MLP
+      intermediate crossing HBM once each per layer, plus the final
+      logits row — an estimate (XLA fuses some of these into neighbors),
+      kept structural so the floor is conservative (higher floor = honest
+      pct-of-roofline).
+
+    Returns the components plus ``total``.
+    """
+    pbytes = _dtype_bytes(cfg.param_dtype)
+    cbytes = _dtype_bytes(cfg.compute_dtype)
+    d, ff, hd = cfg.d_model, cfg.d_ff, cfg.n_heads * cfg.head_dim
+    n = param_count(cfg)
+    n_matmul = n - cfg.padded_vocab_size * d - cfg.max_seq_len * d
+    weights = float(n_matmul) * pbytes
+    kv_read = 2.0 * cfg.n_layers * cache_len * hd * cbytes * batch
+    kv_write = 2.0 * cfg.n_layers * hd * cbytes * batch
+    # Per layer: residual in/out (2d), two LN reads (2d, fp32 but count
+    # cbytes — fused), qkv out (3d), attention out + proj out (2d), MLP
+    # intermediate write+read (2·d_ff), MLP out (d) ≈ 10·d + 2·d_ff per
+    # token; plus the (padded) logits row the head writes.
+    activations = (
+        cfg.n_layers * (10.0 * d + 2.0 * ff) * cbytes * batch
+        + cfg.padded_vocab_size * cbytes * batch
+    )
+    total = weights + kv_read + kv_write + activations
+    return {
+        "weights": weights,
+        "kv_read": kv_read,
+        "kv_write": kv_write,
+        "activations": activations,
+        "total": total,
+    }
+
+
+def decode_roofline_ms(
+    cfg: ModelConfig,
+    batch: int,
+    cache_len: int,
+    hbm_gbps: float = HBM_GBPS_V5E,
+) -> float:
+    """Memory-bandwidth floor for one decode step, in ms (Pope et al.
+    2022's small-batch regime: weight + cache reads at HBM speed bound
+    the step; compute is negligible at these shapes). ``cache_len``
+    should be the mean frontier over the measured run (prompt +
+    new_tokens/2) when scoring a bench row."""
+    total = decode_step_bytes(cfg, batch, cache_len)["total"]
+    return total / (hbm_gbps * 1e9) * 1e3
+
+
 def comm_bytes_per_step(
     cfg: ModelConfig,
     batch: int,

@@ -2,6 +2,12 @@
 per-fusion time breakdown (the PERF.md methodology).
 
 Usage: python scripts/profile_step.py [--batch 32] [--heads 16] [--steps 6]
+
+``--decode`` switches to the serving surface: it traces the KV-cache
+token scan of ``dtc_tpu.generate.generate`` on the flagship model
+(``--batch``/``--prompt-len``/``--new-tokens``/``--decode-attention``
+apply) and prints the scan body's per-fusion attribution in ms/TOKEN —
+the breakdown the decode roofline in PERF.md round 7 is checked against.
 """
 
 from __future__ import annotations
@@ -33,6 +39,45 @@ def run(batch: int, heads: int, steps: int, trace_dir: str, remat: bool,
         block_q_bwd=block_q_bwd, block_kv_bwd=block_kv_bwd,
         moe_experts=moe_experts, moe_dispatch=moe_dispatch,
     )
+
+
+def run_decode(batch: int, trace_dir: str, prompt_len: int, new_tokens: int,
+               decode_attention: str) -> float:
+    """Trace one full generate() call (prefill + token scan) on the
+    flagship decode config; returns measured ms/token (best of 3 untraced
+    windows, same protocol as bench.decode_bench)."""
+    import time
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from bench import FLAGSHIP_DIMS
+    from dtc_tpu.config.schema import ModelConfig
+    from dtc_tpu.generate import generate
+    from dtc_tpu.models.gpt import GPT
+
+    model_cfg = ModelConfig(
+        **FLAGSHIP_DIMS, n_heads=16, max_seq_len=512, dropout=0.0,
+        param_dtype="float32", compute_dtype="bfloat16", attention="auto",
+        decode_attention=decode_attention,
+    )
+    model = GPT(model_cfg)
+    x = jnp.ones((batch, 1), jnp.int32)
+    params = model.init({"params": jax.random.PRNGKey(0)}, x, train=False)["params"]
+    prompt = jax.random.randint(
+        jax.random.PRNGKey(1), (batch, prompt_len), 0,
+        model_cfg.vocab_size, jnp.int32,
+    )
+    np.asarray(generate(model, params, prompt, new_tokens))  # compile
+    with jax.profiler.trace(trace_dir):
+        np.asarray(generate(model, params, prompt, new_tokens))
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        np.asarray(generate(model, params, prompt, new_tokens))
+        best = min(best, time.perf_counter() - t0)
+    return best / new_tokens * 1e3
 
 
 def parse(trace_dir: str, steps: int, top: int):
@@ -67,7 +112,8 @@ def parse(trace_dir: str, steps: int, top: int):
 
 if __name__ == "__main__":
     ap = argparse.ArgumentParser()
-    ap.add_argument("--batch", type=int, default=32)
+    ap.add_argument("--batch", type=int, default=None,
+                    help="default 32 (train step) / 8 (--decode)")
     ap.add_argument("--seq", type=int, default=512)
     ap.add_argument("--block-q", type=int, default=512)
     ap.add_argument("--block-kv", type=int, default=512)
@@ -77,6 +123,13 @@ if __name__ == "__main__":
     ap.add_argument("--moe-experts", type=int, default=0)
     ap.add_argument("--moe-dispatch", default="einsum",
                     choices=["einsum", "sort"])
+    ap.add_argument("--decode", action="store_true",
+                    help="profile the KV-cache decode scan instead of the "
+                         "train step (per-fusion rows are ms/token)")
+    ap.add_argument("--prompt-len", type=int, default=32)
+    ap.add_argument("--new-tokens", type=int, default=128)
+    ap.add_argument("--decode-attention", default="fused",
+                    choices=["fused", "xla"])
     ap.add_argument("--steps", type=int, default=6)
     ap.add_argument("--top", type=int, default=40)
     ap.add_argument(
@@ -86,12 +139,26 @@ if __name__ == "__main__":
     )
     ap.add_argument("--trace-dir", default="/tmp/dtc_trace")
     args = ap.parse_args()
-    remat = False if args.remat == "none" else args.remat
-    step_ms = run(args.batch, args.heads, args.steps, args.trace_dir,
-                  remat, seq=args.seq, block_q=args.block_q,
-                  block_kv=args.block_kv, block_q_bwd=args.block_q_bwd,
-                  block_kv_bwd=args.block_kv_bwd,
-                  moe_experts=args.moe_experts,
-                  moe_dispatch=args.moe_dispatch)
-    print(f"# measured step time: {step_ms:.2f} ms")
-    parse(args.trace_dir, args.steps, args.top)
+    if args.decode:
+        # Decode batch default is the bench's b8 unless overridden.
+        batch = args.batch if args.batch is not None else 8
+        ms_tok = run_decode(batch, args.trace_dir, args.prompt_len,
+                            args.new_tokens, args.decode_attention)
+        print(f"# measured decode ({args.decode_attention}, b{batch}): "
+              f"{ms_tok:.3f} ms/token")
+        # The traced window is ONE generate call = new_tokens scan steps;
+        # dividing by new_tokens prints per-fusion rows in ms/token
+        # (prefill rides in the same trace but is one call of ~prompt_len
+        # amortized over new_tokens rows — noted, not subtracted).
+        parse(args.trace_dir, args.new_tokens, args.top)
+    else:
+        batch = args.batch if args.batch is not None else 32
+        remat = False if args.remat == "none" else args.remat
+        step_ms = run(batch, args.heads, args.steps, args.trace_dir,
+                      remat, seq=args.seq, block_q=args.block_q,
+                      block_kv=args.block_kv, block_q_bwd=args.block_q_bwd,
+                      block_kv_bwd=args.block_kv_bwd,
+                      moe_experts=args.moe_experts,
+                      moe_dispatch=args.moe_dispatch)
+        print(f"# measured step time: {step_ms:.2f} ms")
+        parse(args.trace_dir, args.steps, args.top)

@@ -40,6 +40,41 @@ import pytest  # noqa: E402
 from dtc_tpu.config.schema import MeshConfig, ModelConfig, OptimConfig, TrainConfig  # noqa: E402
 
 
+# Heavyweight suites kept OUT of `-m quick` but still in tier-1
+# (`-m 'not slow'` — its scope is unchanged by the tiering): the PP
+# schedule files pay minutes of 1F1B trace+XLA-compile per test, the
+# multihost file launches real 2-process runs, the resilience file
+# drives full chaos/rollback training runs, and the checkpoint file is
+# Orbax + SIGTERM-subprocess I/O (187 s solo). Measured per-file on this
+# 1-core host (PR 4), including any of them pushes `-m quick` past its
+# 15-min budget.
+_QUICK_EXCLUDE_FILES = {
+    "test_pp_1f1b.py",
+    "test_pp_dropout.py",
+    "test_pp_vocab_chunking.py",
+    "test_multihost.py",
+    "test_resilience.py",
+    "test_checkpoint.py",
+}
+
+
+def pytest_collection_modifyitems(config, items):
+    """Test tiering (round-5 VERDICT #6): anything not opted into a
+    heavier tier is `quick`, so `pytest -m quick` is the <= 15-min
+    critical path on a 1-core host, `-m kernels` the interpret-mode
+    Pallas suites, `-m slow` the subprocess/perf tests — and the tier-1
+    command (`-m 'not slow'`) is unchanged. Marking is additive-by-default
+    so a NEW test file lands in `quick` without any registration step
+    (unless listed in _QUICK_EXCLUDE_FILES above)."""
+    for item in items:
+        if (
+            item.get_closest_marker("slow") is None
+            and item.get_closest_marker("kernels") is None
+            and item.path.name not in _QUICK_EXCLUDE_FILES
+        ):
+            item.add_marker(pytest.mark.quick)
+
+
 @pytest.fixture(scope="session", autouse=True)
 def _assert_eight_devices():
     assert jax.device_count() == 8, (

@@ -1,5 +1,10 @@
 """KV-cache decode correctness: cached generation must reproduce the
-no-cache oracle (full re-forward per token) exactly in fp32."""
+no-cache oracle (full re-forward per token) exactly in fp32, and the
+fused Pallas decode backend (``decode_attention: fused``,
+ops/decode_attention.py) must be token-exact against the XLA oracle
+backend on every path — greedy, sampled, and TP-sharded."""
+
+import dataclasses
 
 import jax
 import jax.numpy as jnp
@@ -97,10 +102,143 @@ def test_overflow_raises(model_and_params, tiny_model_cfg):
         generate(model, params, prompt, 8)
 
 
-def test_tp_sharded_decode_matches_single_device(model_and_params, tiny_model_cfg):
-    """Greedy decode under a TP mesh (params + KV cache sharded over heads)
-    must be token-for-token identical to single-device decode — round-3
-    VERDICT next #9."""
+def test_fused_and_xla_decode_token_exact(model_and_params, tiny_model_cfg):
+    """The decode_attention knob is a pure execution-strategy switch:
+    fused and xla must produce IDENTICAL tokens (greedy and sampled,
+    same rng) — argmax/categorical decisions don't tolerate drift, so
+    this is the token-level parity bar the ISSUE sets."""
+    _, params = model_and_params
+    prompt = jax.random.randint(
+        jax.random.PRNGKey(5), (2, 5), 0, tiny_model_cfg.vocab_size, jnp.int32
+    )
+    outs = {}
+    for backend in ("fused", "xla"):
+        model = GPT(dataclasses.replace(tiny_model_cfg, decode_attention=backend))
+        greedy = generate(model, params, prompt, 8)
+        sampled = generate(model, params, prompt, 8, jax.random.PRNGKey(9),
+                           temperature=0.8, top_k=12, top_p=0.9)
+        outs[backend] = (np.asarray(greedy), np.asarray(sampled))
+    np.testing.assert_array_equal(outs["fused"][0], outs["xla"][0])
+    np.testing.assert_array_equal(outs["fused"][1], outs["xla"][1])
+
+
+def test_cache_layout_roundtrip(model_and_params, tiny_model_cfg):
+    """The packed (B, S, H·D) cache is written by lane-aligned
+    dynamic_update_slice: feeding the prompt token-by-token must build
+    byte-identical cache contents to one prefill write, slots beyond the
+    write frontier must stay zero, and the packed buffer must reshape
+    (bitcast) to the (B, S, H, D) head layout the XLA oracle consumes."""
+    model, params = model_and_params
+    cfg = tiny_model_cfg
+    prompt = jax.random.randint(
+        jax.random.PRNGKey(4), (1, 6), 0, cfg.vocab_size, jnp.int32
+    )
+    cache = init_cache(model, 1)
+    _, pre = model.apply(
+        {"params": params, "cache": cache}, prompt,
+        train=False, decode=True, mutable=["cache"],
+    )
+    cache = init_cache(model, 1)
+    for i in range(prompt.shape[1]):
+        _, mut = model.apply(
+            {"params": params, "cache": cache}, prompt[:, i : i + 1],
+            train=False, decode=True, mutable=["cache"],
+        )
+        cache = mut["cache"]
+    # atol 1e-5: the 6-token prefill matmul and the 1-token step matmul
+    # vectorize differently on CPU (same tolerance the prefill-vs-full
+    # logits tests above use).
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-5
+        ),
+        pre["cache"], cache,
+    )
+    k0 = np.asarray(
+        pre["cache"]["stage"]["blocks"]["Block_0"]["attn"]["k"][0]  # layer 0
+    )
+    assert k0.shape == (1, cfg.max_seq_len, cfg.n_heads * cfg.head_dim)
+    assert np.any(k0[:, : prompt.shape[1]] != 0)
+    assert np.all(k0[:, prompt.shape[1]:] == 0), "write leaked past the frontier"
+    # Layout check with teeth: the packed buffer's two consumers — the
+    # fused kernel (per-head LANE slices) and the XLA oracle (a reshape
+    # to (B, S, H, D)) — must agree on this model-produced cache. Were
+    # heads packed any way other than D-contiguous, the lane slices and
+    # the reshape would read different columns and disagree.
+    from dtc_tpu.ops.attention import decode_attention as xla_oracle
+    from dtc_tpu.ops.decode_attention import fused_decode_attention
+
+    h, d, s = cfg.n_heads, cfg.head_dim, cfg.max_seq_len
+    v0 = np.asarray(
+        pre["cache"]["stage"]["blocks"]["Block_0"]["attn"]["v"][0]
+    )
+    q = jax.random.normal(jax.random.PRNGKey(7), (1, 1, h * d), k0.dtype)
+    start = jnp.int32(prompt.shape[1] - 1)
+    from_lanes = fused_decode_attention(
+        q, jnp.asarray(k0), jnp.asarray(v0), start, h=h, d=d
+    )
+    from_reshape = xla_oracle(
+        q.reshape(1, 1, h, d),
+        jnp.asarray(k0).reshape(1, s, h, d),
+        jnp.asarray(v0).reshape(1, s, h, d),
+        start,
+    )
+    np.testing.assert_allclose(
+        np.asarray(from_lanes).reshape(1, 1, h, d),
+        np.asarray(from_reshape), atol=1e-5,
+    )
+
+
+def test_fused_decode_kernel_matches_fp32_oracle(monkeypatch):
+    """Interpret-mode kernel check vs the fp32 XLA oracle, both grid
+    flavors: single-tile (cache fits one KV block) and blocked
+    (online-softmax walk with beyond-frontier block skip). The blocked
+    thresholds are shrunk so that path runs at a CPU-interpretable shape
+    (the same monkeypatch idiom test_flash_attention.py uses for
+    _PACKED_MAX_T)."""
+    from dtc_tpu.ops import decode_attention as fused_mod
+    from dtc_tpu.ops.attention import decode_attention
+
+    monkeypatch.setattr(fused_mod, "_DECODE_MAX_SINGLE_S", 128)
+    monkeypatch.setattr(fused_mod, "_DECODE_BLOCK_S", 64)
+    for (b, s, h, d, start) in [
+        (2, 64, 4, 16, 13),          # single-tile, ungrouped heads (g=h)
+        (1, 128, 4, 32, 127),        # single-tile, lane-grouped (g=4)
+        (1, 256, 2, 8, 100),         # blocked path (s > single-tile max)
+    ]:
+        kq, kk, kv = jax.random.split(jax.random.PRNGKey(s), 3)
+        q = jax.random.normal(kq, (b, 1, h, d), jnp.float32)
+        k = jax.random.normal(kk, (b, s, h, d), jnp.float32)
+        v = jax.random.normal(kv, (b, s, h, d), jnp.float32)
+        valid = (jnp.arange(s) <= start)[None, :, None, None]
+        k = jnp.where(valid, k, 0.0)
+        v = jnp.where(valid, v, 0.0)
+        ref = decode_attention(q, k, v, jnp.int32(start))
+        got = fused_mod.fused_decode_attention(
+            q.reshape(b, 1, h * d), k.reshape(b, s, h * d),
+            v.reshape(b, s, h * d), jnp.int32(start), h=h, d=d,
+        ).reshape(b, 1, h, d)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(ref), atol=2e-5,
+            err_msg=f"shape b={b} s={s} h={h} d={d} start={start}",
+        )
+    # Unsupported cache lengths must be rejected loudly (the model layer
+    # gates on supports() and falls back to the xla path).
+    assert not fused_mod.supports(256 + 17)
+    with pytest.raises(ValueError, match="cache length"):
+        fused_mod.fused_decode_attention(
+            jnp.zeros((1, 1, 8)), jnp.zeros((1, 273, 8)),
+            jnp.zeros((1, 273, 8)), jnp.int32(0), h=1, d=8,
+        )
+
+
+@pytest.mark.parametrize("backend", ["fused", "xla"])
+def test_tp_sharded_decode_matches_single_device(model_and_params, tiny_model_cfg,
+                                                 backend):
+    """Greedy decode under a TP mesh (params + KV cache sharded over heads
+    — the packed cache's lane axis carries the "heads" logical name) must
+    be token-for-token identical to single-device decode — round-3
+    VERDICT next #9, now for BOTH decode backends."""
     from flax import linen as nn
     from jax.sharding import NamedSharding
     from jax.sharding import PartitionSpec as P
@@ -109,7 +247,8 @@ def test_tp_sharded_decode_matches_single_device(model_and_params, tiny_model_cf
     from dtc_tpu.parallel.mesh import mesh_from_config
     from dtc_tpu.parallel.sharding import DEFAULT_RULES, param_specs
 
-    model, params = model_and_params
+    _, params = model_and_params
+    model = GPT(dataclasses.replace(tiny_model_cfg, decode_attention=backend))
     prompt = jax.random.randint(jax.random.PRNGKey(3), (2, 5), 0,
                                 tiny_model_cfg.vocab_size, dtype=jnp.int32)
     want = generate(model, params, prompt, 8)

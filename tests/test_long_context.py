@@ -10,11 +10,16 @@ v5e chip's 16 GiB HBM — and matches the dense oracle computed on the host
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from dtc_tpu.config.schema import MeshConfig
 from dtc_tpu.ops.attention import dense_causal_attention
 from dtc_tpu.ops.ring_attention import ring_causal_attention
 from dtc_tpu.parallel.mesh import mesh_from_config
+
+# Interpret-mode kernel suite: minutes on a 1-core host. `pytest -m quick`
+# skips it; tier-1 (`-m 'not slow'`) still runs it.
+pytestmark = pytest.mark.kernels
 
 T_LONG = 8192
 

@@ -7,6 +7,9 @@ import pytest
 from dtc_tpu.config.schema import ModelConfig
 from dtc_tpu.utils.metrics import (
     comm_bytes_per_step,
+    decode_roofline_ms,
+    decode_step_bytes,
+    decode_step_flops,
     gpt_step_flops,
     mfu,
     moe_step_flops,
@@ -174,6 +177,42 @@ def test_comm_bytes_tp_activation_allreduce():
     expect = 4.0 * L * 2.0 * (2 - 1) / 2 * act
     assert c["tp_allreduce"] == pytest.approx(expect)
     assert c["dp_allreduce"] == 0.0
+
+
+def test_decode_step_flops_hand_computed():
+    cfg = _cfg()
+    batch, cache_len = 4, 20
+    n_matmul = _dense_param_count() - PAD_V * D - T * D
+    dense = 2.0 * n_matmul * batch          # one token, forward only
+    attn = 4.0 * L * batch * cache_len * D  # QK + PV single-query rows
+    assert decode_step_flops(cfg, batch, cache_len) == pytest.approx(dense + attn)
+
+
+def test_decode_step_bytes_components_and_batch_amortization():
+    cfg = _cfg(param_dtype="float32", compute_dtype="bfloat16")
+    n_matmul = _dense_param_count() - PAD_V * D - T * D
+    b8 = decode_step_bytes(cfg, 8, 16)
+    # Weight read is 4 bytes/param and BATCH-INDEPENDENT — the
+    # amortization that makes wider decode batches win.
+    assert b8["weights"] == pytest.approx(n_matmul * 4.0)
+    assert decode_step_bytes(cfg, 64, 16)["weights"] == b8["weights"]
+    # KV terms scale with batch and cache length, in compute dtype.
+    assert b8["kv_read"] == pytest.approx(2.0 * L * 16 * (H * (D // H)) * 2 * 8)
+    assert decode_step_bytes(cfg, 8, 32)["kv_read"] == 2 * b8["kv_read"]
+    assert b8["kv_write"] == pytest.approx(2.0 * L * (H * (D // H)) * 2 * 8)
+    assert b8["total"] == pytest.approx(
+        b8["weights"] + b8["kv_read"] + b8["kv_write"] + b8["activations"]
+    )
+
+
+def test_decode_roofline_is_bytes_over_bandwidth():
+    cfg = _cfg()
+    total = decode_step_bytes(cfg, 8, 16)["total"]
+    assert decode_roofline_ms(cfg, 8, 16, hbm_gbps=819.0) == pytest.approx(
+        total / 819e9 * 1e3
+    )
+    # Wider batch moves the floor sublinearly: weights amortize.
+    assert decode_roofline_ms(cfg, 64, 16) < 8 * decode_roofline_ms(cfg, 8, 16)
 
 
 def test_comm_bytes_pp_boundary_sends():

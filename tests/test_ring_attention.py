@@ -21,6 +21,10 @@ from dtc_tpu.parallel.mesh import mesh_from_config
 from dtc_tpu.parallel.sharding import RING_RULES
 from dtc_tpu.train.trainer import train
 
+# Interpret-mode kernel suite: minutes on a 1-core host. `pytest -m quick`
+# skips it; tier-1 (`-m 'not slow'`) still runs it.
+pytestmark = pytest.mark.kernels
+
 
 def _qkv(key, b, t, h, d):
     ks = jax.random.split(key, 3)
