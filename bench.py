@@ -253,30 +253,65 @@ def decode_bench(
     }
 
 
+def _bench_detail(path: str) -> dict:
+    """Parsed ``# bench-detail:`` dict of one committed BENCH file, or {}.
+
+    Tolerates any malformed/foreign file shape — the guard is advisory
+    and must never be the reason a bench run dies."""
+    import re
+
+    try:
+        with open(path) as f:
+            prev_raw = json.load(f)
+        # The committed files wrap the run: the detail dict lives on the
+        # "# bench-detail:" line inside "tail".
+        m = re.search(r"# bench-detail: (\{.*\})", prev_raw.get("tail", ""))
+        return json.loads(m.group(1)) if m else {}
+    except (OSError, ValueError, AttributeError, TypeError):
+        return {}
+
+
 def decode_drift_guard(extra: dict, repo_dir: str | None = None) -> list[str]:
     """Compare this run's decode rows against the newest committed
-    ``BENCH_r*.json`` and flag any ms/token regression > 20% — the same
-    drift discipline the training rows get from round-over-round BENCH
-    comparison, applied automatically so a serving regression cannot ship
-    silently inside an otherwise-green bench. Returns human-readable
-    flag strings (also stored under ``extra["decode_regressions"]``)."""
+    ``BENCH_r*.json`` that HAS decode rows and flag any ms/token
+    regression > 20% — the same drift discipline the training rows get
+    from round-over-round BENCH comparison, applied automatically so a
+    serving regression cannot ship silently inside an otherwise-green
+    bench. Returns human-readable flag strings (also stored under
+    ``extra["decode_regressions"]``).
+
+    Degrades gracefully: a newest file without decode rows (e.g. a round
+    whose decode configs all ``_safe``-errored) falls back to older
+    files, and when NO committed file carries a decode ms/token the guard
+    prints a warning and compares nothing — it never raises."""
     import glob
     import os
-    import re
 
     repo_dir = repo_dir or os.path.dirname(os.path.abspath(__file__))
     paths = sorted(glob.glob(os.path.join(repo_dir, "BENCH_r*.json")))
     flags: list[str] = []
     if not paths:
         return flags
-    try:
-        with open(paths[-1]) as f:
-            prev_raw = json.load(f)
-        # The committed files wrap the run: the detail dict lives on the
-        # "# bench-detail:" line inside "tail".
-        m = re.search(r"# bench-detail: (\{.*\})", prev_raw.get("tail", ""))
-        prev = json.loads(m.group(1)) if m else {}
-    except (OSError, ValueError):
+
+    def has_decode(detail: dict) -> bool:
+        return any(
+            label.startswith("decode") and isinstance(row, dict)
+            and "ms_per_token" in row
+            for label, row in detail.items()
+        )
+
+    prev, prev_path = {}, None
+    for path in reversed(paths):
+        detail = _bench_detail(path)
+        if has_decode(detail):
+            prev, prev_path = detail, path
+            break
+    if prev_path is None:
+        print(
+            "# decode drift guard: no committed BENCH_r*.json carries "
+            "decode rows — nothing to compare against (guard inactive "
+            "this run)"
+        )
         return flags
     for label, row in extra.items():
         if not (isinstance(row, dict) and label.startswith("decode")):
@@ -285,10 +320,13 @@ def decode_drift_guard(extra: dict, repo_dir: str | None = None) -> list[str]:
         if not (isinstance(old, dict) and "ms_per_token" in old):
             continue
         new_ms, old_ms = row.get("ms_per_token"), old["ms_per_token"]
-        if new_ms and old_ms and new_ms > 1.2 * old_ms:
+        if (
+            isinstance(new_ms, (int, float)) and isinstance(old_ms, (int, float))
+            and new_ms and old_ms and new_ms > 1.2 * old_ms
+        ):
             flags.append(
                 f"{label}: {new_ms} ms/token vs {old_ms} in "
-                f"{os.path.basename(paths[-1])} (+{(new_ms / old_ms - 1) * 100:.0f}%)"
+                f"{os.path.basename(prev_path)} (+{(new_ms / old_ms - 1) * 100:.0f}%)"
             )
     if flags:
         extra["decode_regressions"] = flags

@@ -1,0 +1,52 @@
+"""Graph auditor: static analysis over lowered jaxprs and compiled HLO.
+
+The paper's DP/TP/PP comparison is only as honest as the compiled
+programs behind it: GSPMD derives every collective from sharding
+annotations, so a drifted annotation silently turns "shard the experts"
+into "replicate everything and slice" — numerically identical, and
+invisible to every loss-parity test in the suite. This package makes the
+compiled program itself an asserted artifact:
+
+- :mod:`lowering` — one registry of auditable entry points (the train
+  step per parallel mode on the 8-virtual-device CPU mesh, the greedy
+  decode path), each lowered/compiled exactly the way the trainer runs
+  it (committed input shardings — in this env the in-graph logical
+  constraints are no-ops and placement flows entirely from committed
+  arguments, which the audit of record must mirror);
+- :mod:`hlo` — text-level parsing of the optimized HLO: collective
+  census with result-buffer byte estimates, ``input_output_alias``
+  donation map, dtype scans;
+- :mod:`hostsync` — AST lint of the trainer's timed loop for host
+  synchronization (``device_get`` / ``block_until_ready`` / ``.item()``)
+  outside the sanctioned boundaries;
+- :mod:`rules` — the rule engine: five families (collective census +
+  forbidden gathers, donation audit, dtype/promotion audit, host-sync
+  lint, recompile fingerprint) producing severity-ranked findings;
+- :mod:`report` — JSON report assembly, per-entry-point fingerprints,
+  committed-baseline read/write/diff (the drift gate).
+
+``scripts/audit_graph.py`` is the CLI; ``scripts/verify_tier1.sh`` runs
+it as a pre-gate; ``tests/test_collectives_hlo.py`` asserts through the
+same engine so the one-off round-5 HLO checks and the permanent audit
+cannot drift apart.
+"""
+
+from dtc_tpu.analysis.hlo import (  # noqa: F401
+    all_gather_shapes,
+    collective_census,
+    collective_counts,
+    input_output_alias_count,
+)
+from dtc_tpu.analysis.lowering import (  # noqa: F401
+    Artifact,
+    build_artifacts,
+    compiled_train_hlo,
+)
+from dtc_tpu.analysis.report import (  # noqa: F401
+    BASELINE_DIR,
+    check_baselines,
+    build_report,
+    write_baselines,
+)
+from dtc_tpu.analysis.hostsync import lint_file, lint_source, unsanctioned  # noqa: F401
+from dtc_tpu.analysis.rules import Finding, audit_artifact, audit_hostsync  # noqa: F401

@@ -1,0 +1,180 @@
+"""Text-level parsing of XLA's optimized HLO dumps.
+
+Everything here is pure string processing — no JAX imports — so the
+parsers unit-test without a backend and run on HLO text captured
+anywhere (CPU audit runs, TPU dumps shipped home from a pod).
+
+HLO text format notes (what the regexes lean on):
+
+- One instruction per line: ``%name = <type> <op>(operands), attrs``.
+  The result type is either a single ``dtype[dims]{layout}`` or a tuple
+  ``(dtype[dims]{..}, ...)`` for variadic ops (a multi-operand
+  all-reduce produces a tuple result — its bytes are the SUM of the
+  element buffers).
+- The donation map lives on the ``HloModule`` header line as
+  ``input_output_alias={ {out_idx}: (param, {param_idx}, may-alias),.. }``
+  — one entry per aliased (donated) buffer.
+- XLA's CPU pipeline DECOMPOSES reduce-scatter into all-reduce +
+  partition-id-indexed dynamic-slice, so CPU audits accept the
+  ``partition-id`` fingerprint where a TPU dump would show the literal
+  instruction (same tolerance tests/test_collectives_hlo.py has always
+  applied).
+"""
+
+from __future__ import annotations
+
+import math
+import re
+from collections import Counter
+
+#: The cross-device ops the census tracks (collective-permute carries
+#: pipeline/ring traffic; the other four are the GSPMD workhorses).
+COLLECTIVE_OPS = (
+    "all-to-all",
+    "all-gather",
+    "all-reduce",
+    "reduce-scatter",
+    "collective-permute",
+)
+
+# One instruction per line: "%name = <type> <op>(".  The type can be a
+# tuple (contains spaces), so match lazily up to the op name.  This is
+# the exact expression tests/test_collectives_hlo.py pinned in round 5;
+# it now lives here so the test and the audit share one definition.
+_INSTR = re.compile(
+    r"%[\w.-]+ = .*? (" + "|".join(COLLECTIVE_OPS) + r")\("
+)
+
+#: result-type capture for one collective line: everything between "= "
+#: and " <op>(" — a single typed buffer or a tuple of them.
+_RESULT = re.compile(
+    r"%[\w.-]+ = (.*?) (" + "|".join(COLLECTIVE_OPS) + r")\("
+)
+
+#: a single typed buffer inside a result type, e.g. "f32[8,32,64]".
+_BUFFER = re.compile(r"(pred|[a-z]+\d+)\[([\d,]*)\]")
+
+#: one "{out}: (param, {idx}, kind)" entry of the header's alias map.
+_ALIAS_ENTRY = re.compile(r"\{[\d,\s]*\}:\s*\(\d+,\s*\{[\d,\s]*\}")
+
+_DTYPE_BYTES = {
+    "pred": 1,
+    "s8": 1, "u8": 1, "f8": 1,
+    "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
+    "s32": 4, "u32": 4, "f32": 4,
+    "s64": 8, "u64": 8, "f64": 8, "c64": 8,
+    "c128": 16,
+}
+
+
+def dtype_bytes(dtype: str) -> int:
+    return _DTYPE_BYTES.get(dtype, 4)
+
+
+def _buffer_bytes(type_text: str) -> int:
+    """Total bytes of a result type (sums tuple elements)."""
+    total = 0
+    for dtype, dims in _BUFFER.findall(type_text):
+        n = 1
+        if dims:
+            n = math.prod(int(d) for d in dims.split(","))
+        total += n * dtype_bytes(dtype)
+    return total
+
+
+def collective_counts(txt: str) -> Counter:
+    """Per-op instruction counts — the round-5 test's ``_collectives``."""
+    return Counter(_INSTR.findall(txt))
+
+
+def collective_census(txt: str) -> dict[str, dict[str, int]]:
+    """Per-op ``{"count": n, "bytes": b}`` over the module.
+
+    ``bytes`` sums each instruction's RESULT buffer (post-gather size for
+    all-gather, full size for all-reduce, shard size for reduce-scatter)
+    — a deterministic graph property suited to baselining, NOT a wire-
+    traffic model (ring-algorithm wire bytes differ by the usual
+    ``(n-1)/n`` factors; the census cross-check in rules.py applies
+    those tolerances).
+    """
+    census: dict[str, dict[str, int]] = {}
+    for m in _RESULT.finditer(txt):
+        type_text, op = m.group(1), m.group(2)
+        row = census.setdefault(op, {"count": 0, "bytes": 0})
+        row["count"] += 1
+        row["bytes"] += _buffer_bytes(type_text)
+    return census
+
+
+def all_gather_shapes(txt: str) -> list[str]:
+    """Result shapes of every all-gather, as ``"f32[8,32,64]"`` strings —
+    the exact format the round-5 forbidden-gather regexes match. A
+    variadic (combined) all-gather's tuple result contributes one entry
+    per element buffer: XLA's all-gather combiner routinely merges
+    gathers on TPU, and a forbidden shape hidden inside a combined op
+    must still be visible to the rules."""
+    return [
+        f"{d}[{','.join(str(x) for x in dims)}]"
+        for d, dims in all_gather_dims(txt)
+    ]
+
+
+def all_gather_dims(txt: str) -> list[tuple[str, tuple[int, ...]]]:
+    """(dtype, dims) of every all-gather result buffer — the structured
+    form the forbidden-shape rule compares against parameter shapes.
+    Tuple results (combined all-gathers) are flattened to their element
+    buffers, same as ``_buffer_bytes`` sums them."""
+    out = []
+    for m in _RESULT.finditer(txt):
+        if m.group(2) != "all-gather":
+            continue
+        for d, dims_txt in _BUFFER.findall(m.group(1)):
+            dims = tuple(int(x) for x in dims_txt.split(",")) if dims_txt else ()
+            out.append((d, dims))
+    return out
+
+
+def input_output_alias_count(txt: str) -> int:
+    """Number of aliased (donated) buffers in the module header.
+
+    The entry pattern is applied to the whole ``HloModule`` line: the
+    alias map's braces nest (``{out}: (param, {idx}, kind)``), so there is
+    no clean non-greedy way to isolate the map itself — but the entry
+    shape is specific enough to count directly, and nothing else on the
+    header line matches it."""
+    header = txt.split("\n", 1)[0]
+    if "input_output_alias" not in header:
+        return 0
+    return len(_ALIAS_ENTRY.findall(header))
+
+
+def has_partition_id(txt: str) -> bool:
+    """CPU fingerprint of a decomposed reduce-scatter (see module doc)."""
+    return "partition-id" in txt
+
+
+def count_dtype(txt: str, dtype: str) -> int:
+    """Occurrences of ``dtype[`` — e.g. ``count_dtype(txt, "f64")``."""
+    return txt.count(f"{dtype}[")
+
+
+def dot_dtype_counts(stablehlo_text: str) -> dict[str, int]:
+    """bf16 vs f32 ``dot_general`` counts in LOWERED StableHLO text.
+
+    The bf16-region audit runs on the lowering, not the compiled module:
+    the CPU backend legalizes/promotes small dtypes (and check-fails on
+    some bf16 collectives — see tests/conftest.py), so only the
+    backend-independent StableHLO faithfully shows which matmuls the
+    model declared in bf16. An unintended upcast shows up here as an
+    f32 dot_general replacing a bf16 one — a count change the baseline
+    drift gate flags even when no rule hard-fails.
+    """
+    bf16 = f32 = 0
+    for line in stablehlo_text.splitlines():
+        if "dot_general" not in line:
+            continue
+        if "bf16" in line:
+            bf16 += 1
+        elif "f32" in line:
+            f32 += 1
+    return {"bf16_dots": bf16, "f32_dots": f32}

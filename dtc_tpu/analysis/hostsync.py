@@ -1,0 +1,176 @@
+"""AST lint: host synchronization inside the trainer's hot loop.
+
+Every ``jax.device_get`` / ``block_until_ready`` / ``.item()`` in the
+timed training loop is a device round-trip that serializes async dispatch
+— the exact per-step sync the trainer was built to avoid (the reference
+pays one every step; see train/trainer.py's module doc). The loop DOES
+legitimately sync at telemetry boundaries: the log-window loss fetch, the
+eval pass, the checkpoint health gate, the opt-in per-step
+``sync_every_step`` timing mode. So the lint is not "no syncs" but "no
+syncs outside a sanctioned boundary":
+
+- the **hot loop** is any ``while``/``for`` whose condition/iterator
+  mentions ``step`` (the trainer has exactly one: ``while step <
+  train_cfg.steps``);
+- a sync site is **sanctioned** when it sits in the TEST or BODY of an
+  enclosing ``if`` whose condition mentions one of the boundary knobs
+  below (``log_every``, ``checkpoint_every``, …) — an ``else`` branch is
+  NOT sanctioned (it runs exactly when the boundary condition is false,
+  i.e. every ordinary step). The knob's presence in the test source is
+  the contract, so renaming one without updating this table fails loudly
+  in tests/test_analysis.py;
+- nested ``def``s are skipped: helpers like ``do_rollback``/``run_eval``
+  are defined outside the loop and called only from boundaries.
+
+Pure static analysis (``ast`` on source text): no JAX import, no trainer
+import, so it lints any file — including the deliberately-broken fixture
+the tests point it at.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+
+#: Call names that force a host<->device round trip.
+SYNC_CALLS = frozenset({
+    "device_get",          # jax.device_get(...)
+    "block_until_ready",   # jax.block_until_ready(x) / x.block_until_ready()
+    "item",                # scalar fetch: x.item()
+    "asarray",             # np.asarray(device_array) — a transfer
+})
+
+#: Substrings that mark an enclosing ``if`` as a sanctioned telemetry /
+#: control boundary. These are the trainer's boundary knobs: the log
+#: window, periodic eval, periodic checkpoint, graceful-stop drain, and
+#: the opt-in per-step timing sync.
+SANCTIONED_CONDITIONS = (
+    "log_every",
+    "eval_every",
+    "checkpoint_every",
+    "stopping",
+    "sync_every_step",
+)
+
+#: Default lint target: the trainer module itself.
+TRAINER_PATH = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "train", "trainer.py",
+)
+
+
+@dataclasses.dataclass
+class SyncSite:
+    """One host-sync call found inside a hot loop."""
+
+    path: str
+    lineno: int
+    call: str            # the SYNC_CALLS member that matched
+    code: str            # unparsed call expression
+    sanctioned: bool
+    boundary: str | None  # condition text of the sanctioning ``if``
+
+
+def _call_name(node: ast.Call) -> str | None:
+    f = node.func
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    if isinstance(f, ast.Name):
+        return f.id
+    return None
+
+
+def _is_hot_loop(node: ast.AST) -> bool:
+    if isinstance(node, ast.While):
+        probe = ast.unparse(node.test)
+    elif isinstance(node, ast.For):
+        probe = ast.unparse(node.iter)
+    else:
+        return False
+    return "step" in probe
+
+
+def _walk_loop(
+    loop: ast.AST, path: str, sites: list[SyncSite]
+) -> None:
+    """Collect sync calls under ``loop``, threading down the innermost
+    sanctioning ``if`` condition. Nested ``def``s are skipped (they only
+    run when *called*, and the trainer calls them from boundaries).
+
+    Sanctioning is branch-aware: only a marker-``if``'s TEST and BODY are
+    gated by its condition — the ``else`` branch runs precisely when the
+    boundary condition is false (every non-boundary step), so a sync
+    there is the per-step regression the lint exists to catch and must
+    NOT inherit the sanction."""
+
+    def visit(node: ast.AST, boundary: str | None) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return
+        if isinstance(node, ast.Call):
+            name = _call_name(node)
+            if name in SYNC_CALLS:
+                sites.append(SyncSite(
+                    path=path,
+                    lineno=node.lineno,
+                    call=name,
+                    code=ast.unparse(node),
+                    sanctioned=boundary is not None,
+                    boundary=boundary,
+                ))
+        if isinstance(node, ast.If):
+            cond = ast.unparse(node.test)
+            gated = boundary
+            if any(marker in cond for marker in SANCTIONED_CONDITIONS):
+                gated = cond
+            visit(node.test, gated)
+            for child in node.body:
+                visit(child, gated)
+            for child in node.orelse:
+                visit(child, boundary)  # else: condition is FALSE here
+            return
+        for child in ast.iter_child_nodes(node):
+            visit(child, boundary)
+
+    # Walk the whole loop node: a sync in the loop's own condition is a
+    # per-iteration sync too, so it is included alongside the body.
+    visit(loop, None)
+
+
+def lint_source(source: str, path: str = "<string>") -> list[SyncSite]:
+    """All sync sites inside hot loops of ``source``."""
+    tree = ast.parse(source, filename=path)
+    sites: list[SyncSite] = []
+    seen: set[int] = set()
+
+    def covered(node: ast.AST) -> list[ast.AST]:
+        """Hot loops whose sites _walk_loop(node) collects — i.e. nested
+        loops reachable WITHOUT crossing a def boundary (a hot loop
+        inside a nested ``def`` is skipped by the walk, so it must stay
+        eligible for its own top-level pass)."""
+        out = []
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return out
+        if _is_hot_loop(node):
+            out.append(node)
+        for child in ast.iter_child_nodes(node):
+            out.extend(covered(child))
+        return out
+
+    for node in ast.walk(tree):
+        if _is_hot_loop(node) and id(node) not in seen:
+            # Mark covered nested loops as seen so they are not walked
+            # twice (their sites already collected here).
+            for sub in covered(node):
+                seen.add(id(sub))
+            _walk_loop(node, path, sites)
+    return sites
+
+
+def lint_file(path: str = TRAINER_PATH) -> list[SyncSite]:
+    with open(path) as f:
+        return lint_source(f.read(), path)
+
+
+def unsanctioned(sites: list[SyncSite]) -> list[SyncSite]:
+    return [s for s in sites if not s.sanctioned]

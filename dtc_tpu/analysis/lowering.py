@@ -1,0 +1,382 @@
+"""Auditable entry points: lower/compile the real steps, capture evidence.
+
+One registry of (mode -> lowering recipe) so the audit CLI, the drift
+baselines, and the HLO collective tests all compile THE SAME programs the
+trainer runs. Fidelity notes that make the audit representative:
+
+- Input shardings are COMMITTED (``jax.device_put`` of the batch under the
+  mode's ``batch_spec``, ``init_state``'s placed params): in this
+  environment flax's in-graph logical constraints lower to nothing, so
+  GSPMD derives every collective from committed argument shardings alone —
+  exactly how the trainer feeds the step (``prefetch.split_put``). Lowering
+  an uncommitted batch produces a collective-free module that would
+  "pass" every census vacuously.
+- The audit model is the test suite's tiny config (fp32 compute: XLA's CPU
+  backend check-fails on some bf16 collectives, see tests/conftest.py), on
+  the same 8-virtual-device mesh — baselines are per-(mode, model) and say
+  so in their fingerprint.
+- Recompile fingerprints come from EXECUTING the compiled step twice under
+  the obs compile watcher: cold must compile exactly once (two means the
+  PR 1 out-shardings bug class is back), steady must compile zero times.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+from jax.sharding import NamedSharding
+
+from dtc_tpu.config.schema import MeshConfig, ModelConfig, OptimConfig, TrainConfig
+from dtc_tpu.models.gpt import GPT
+from dtc_tpu.obs.stepclock import CompileWatcher
+from dtc_tpu.parallel.mesh import mesh_from_config
+from dtc_tpu.parallel.sharding import (
+    DEFAULT_RULES,
+    FSDP_RULES,
+    batch_spec,
+    ring_rules_from,
+)
+from dtc_tpu.train.train_step import Batch, create_train_step
+from dtc_tpu.utils.metrics import comm_bytes_per_step
+
+#: HLO dtype token for the numpy dtypes the audit model can hold.
+_NP_TO_HLO = {
+    "float32": "f32", "bfloat16": "bf16", "float16": "f16",
+    "float64": "f64", "int32": "s32", "int64": "s64", "uint32": "u32",
+    "bool": "pred",
+}
+
+
+def audit_model_cfg(**overrides: Any) -> ModelConfig:
+    """The audit's tiny model — dimension-for-dimension the test suite's
+    ``tiny_model_cfg`` (divisibility over model=2/4/8, pipe=2/4), so the
+    committed baselines and the HLO tests describe the same programs."""
+    base = dict(
+        vocab_size=97, d_model=64, n_layers=4, n_heads=4, d_ff=128,
+        max_seq_len=32, dropout=0.0, param_dtype="float32",
+        compute_dtype="float32", attention="dense",
+    )
+    base.update(overrides)
+    return ModelConfig(**base)
+
+
+def audit_opt_cfg() -> OptimConfig:
+    return OptimConfig(lr=1e-3, weight_decay=0.1, grad_clip=1.0)
+
+
+def audit_train_cfg(parallel: str, mesh: MeshConfig) -> TrainConfig:
+    return TrainConfig(
+        seed=0, parallel=parallel, batch=8, steps=4, log_every=2,
+        output_dir="", dataset="synthetic", warmup_steps=0, prefetch=0,
+        mesh=mesh,
+    )
+
+
+@dataclasses.dataclass(frozen=True)
+class EntrySpec:
+    """One auditable entry point: how to build + lower it."""
+
+    name: str
+    parallel: str
+    mesh: MeshConfig
+    model_overrides: dict[str, Any]
+    rules: str  # "default" | "fsdp" | "ring"
+
+
+#: The registry. ``dp/tp/fsdp/ep`` are the audit CLI's default set (the
+#: paper's strategy comparison); ``ep_sort`` and ``ulysses`` exist so the
+#: refactored collective tests lower through this same table.
+TRAIN_ENTRIES: dict[str, EntrySpec] = {
+    "dp": EntrySpec("dp", "dp", MeshConfig(), {}, "default"),
+    "tp": EntrySpec("tp", "tp", MeshConfig(), {}, "default"),
+    "fsdp": EntrySpec("fsdp", "fsdp", MeshConfig(), {}, "fsdp"),
+    "ep": EntrySpec(
+        "ep", "3d", MeshConfig(pipe=1, data=4, model=2),
+        dict(moe_experts=4, moe_top_k=2, moe_capacity_factor=2.0), "default",
+    ),
+    "ep_sort": EntrySpec(
+        "ep_sort", "3d", MeshConfig(pipe=1, data=4, model=2),
+        dict(moe_experts=4, moe_top_k=2, moe_capacity_factor=2.0,
+             moe_dispatch="sort"), "default",
+    ),
+    "ulysses": EntrySpec(
+        "ulysses", "3d", MeshConfig(pipe=1, data=2, model=4),
+        dict(attention="ulysses"), "ring",
+    ),
+}
+
+_RULE_TABLES = {
+    "default": DEFAULT_RULES,
+    "fsdp": FSDP_RULES,
+    "ring": ring_rules_from(DEFAULT_RULES),
+}
+
+
+@dataclasses.dataclass
+class Artifact:
+    """Everything the rule engine audits about one lowered entry point.
+
+    The two text blobs are deliberately both kept: the optimized HLO is
+    where collectives/donation/f64 live; the backend-independent StableHLO
+    is where declared matmul dtypes survive CPU legalization (see
+    ``hlo.dot_dtype_counts``).
+    """
+
+    name: str
+    kind: str                       # "train" | "decode"
+    parallel: str | None
+    mesh_shape: dict[str, int]
+    batch: int
+    seq_len: int
+    hlo_text: str
+    stablehlo_text: str
+    expected_donated: int           # donated leaves the alias map must cover
+    param_shapes: list[tuple[str, tuple[int, ...]]]  # sharded params' FULL dims
+    weak_outputs: int               # weak-typed jaxpr outvars
+    n_layers: int
+    moe_experts: int
+    compute_dtype: str
+    cold_compiles: int | None = None   # None = not executed
+    steady_compiles: int | None = None
+    comm_estimate: dict[str, float] | None = None
+
+
+def _param_shapes(params: Any) -> list[tuple[str, tuple[int, ...]]]:
+    """Full (unsharded) parameter shapes as (hlo-dtype, dims)."""
+    out = []
+    for leaf in jax.tree.leaves(params):
+        dt = _NP_TO_HLO.get(str(np.dtype(leaf.dtype)), "f32")
+        out.append((dt, tuple(int(d) for d in leaf.shape)))
+    return out
+
+
+def _sharded_param_shapes(
+    params: Any,
+    rules: Sequence[tuple[str, str | None]],
+    mesh,
+    min_size: int,
+) -> list[tuple[str, tuple[int, ...]]]:
+    """Full shapes of the params that are actually SHARDED under
+    ``rules`` on ``mesh`` (spec keeps a live mesh axis after GSPMD
+    normalization) and at least ``min_size`` elements — the
+    forbidden-gather rule's comparison set.
+
+    Two deliberate exclusions, both verified against healthy graphs:
+
+    - Replicated params: their GRADIENTS are param-shaped and
+      legitimately assembled via all-gather when computed from sharded
+      activations (TP layernorm grads, the EP router grad).
+    - Sub-matrix-scale params (``min_size`` = d_model², i.e. smaller than
+      one weight matrix — the stacked per-layer biases): their shapes
+      collide with incidental small buffers in healthy TP/EP modules, and
+      a gathered bias is noise next to the kernel gather that would
+      accompany a real replicate-and-slice fallback."""
+    from jax.sharding import PartitionSpec as P
+
+    from dtc_tpu.parallel.sharding import param_specs
+    from dtc_tpu.train.train_step import normalize_spec
+
+    specs = param_specs(params, rules)
+    out = []
+    for leaf, spec in zip(
+        jax.tree.leaves(params),
+        jax.tree.leaves(specs, is_leaf=lambda x: isinstance(x, P)),
+    ):
+        norm = normalize_spec(spec, mesh)
+        if leaf.size >= min_size and any(part is not None for part in norm):
+            dt = _NP_TO_HLO.get(str(np.dtype(leaf.dtype)), "f32")
+            out.append((dt, tuple(int(d) for d in leaf.shape)))
+    return out
+
+
+def _measure_compiles(call_once, call_again) -> tuple[int, int]:
+    """Execute an entry point twice under the compile watcher; return the
+    (cold, steady) backend-compile counts. ``call_again`` receives the
+    first call's output so a donating step can feed its own result back
+    (the donated input is dead after call one). Steady > 0 is the silent
+    double-compile the PR 1 watcher caught — here it fails the audit."""
+    watcher = CompileWatcher().activate()
+    try:
+        watcher.drain()
+        out = call_once()
+        jax.block_until_ready(jax.tree.leaves(out)[-1])
+        _, cold = watcher.drain()
+        out = call_again(out)
+        jax.block_until_ready(jax.tree.leaves(out)[-1])
+        _, steady = watcher.drain()
+    finally:
+        watcher.deactivate()
+    return cold, steady
+
+
+def _lower_train_step(
+    parallel: str,
+    mesh_cfg: MeshConfig,
+    model_cfg: ModelConfig,
+    opt_cfg: OptimConfig,
+    rules: Sequence[tuple[str, str | None]],
+):
+    """ONE trainer-faithful lowering for both the audit artifacts and the
+    HLO tests: committed param shardings via ``init_state``, committed
+    batch shardings via ``device_put`` under the mode's ``batch_spec``,
+    out-shardings pinned by passing the placed state into
+    ``create_train_step``. Returns ``(mesh, step, state, batch, rng)``;
+    callers must keep using the mesh/rules context it opens internally
+    only for construction — lower/compile are context-free.
+
+    A single definition on purpose: the module's invariant is that the
+    committed baselines and tests/test_collectives_hlo.py describe THE
+    SAME programs, which duplicate lowering blocks would quietly break.
+    """
+    from dtc_tpu.train.trainer import init_state
+
+    mesh = mesh_from_config(parallel, mesh_cfg)
+    model = GPT(model_cfg)
+    tc = audit_train_cfg(parallel, mesh_cfg)
+    with mesh, nn.logical_axis_rules(rules):
+        state = init_state(model, model_cfg, tc, opt_cfg, mesh, rules)
+        step = create_train_step(mesh, model=model, state=state)
+        x = jax.device_put(
+            np.zeros((tc.batch, model_cfg.max_seq_len), np.int32),
+            NamedSharding(mesh, batch_spec(rules)),
+        )
+    return mesh, step, state, Batch(x=x, y=x), jax.random.PRNGKey(0)
+
+
+def compiled_train_hlo(
+    parallel: str,
+    mesh_cfg: MeshConfig,
+    model_cfg: ModelConfig,
+    opt_cfg: OptimConfig,
+    rules: Sequence[tuple[str, str | None]],
+) -> str:
+    """Optimized-HLO text of the train step, lowered trainer-faithfully.
+    The refactored ``tests/test_collectives_hlo.py`` asserts on this text
+    through the shared parsers in :mod:`dtc_tpu.analysis.hlo`."""
+    mesh, step, state, batch, rng = _lower_train_step(
+        parallel, mesh_cfg, model_cfg, opt_cfg, rules
+    )
+    with mesh, nn.logical_axis_rules(rules):
+        return step.lower(state, batch, rng).compile().as_text()
+
+
+def build_train_artifact(mode: str, *, execute: bool = True) -> Artifact:
+    """Lower + compile one registry train entry and collect the evidence
+    the rules audit. ``execute=True`` additionally runs the step twice for
+    the recompile fingerprint (adds device time, CPU-cheap at this size)."""
+    spec = TRAIN_ENTRIES[mode]
+    model_cfg = audit_model_cfg(**spec.model_overrides)
+    opt_cfg = audit_opt_cfg()
+    rules = _RULE_TABLES[spec.rules]
+    mesh, step, state, batch, rng = _lower_train_step(
+        spec.parallel, spec.mesh, model_cfg, opt_cfg, rules
+    )
+    with mesh, nn.logical_axis_rules(rules):
+        lowered = step.lower(state, batch, rng)
+        stablehlo = lowered.as_text()
+        hlo = lowered.compile().as_text()
+        traced = step.trace(state, batch, rng)
+        weak = sum(
+            1 for v in traced.jaxpr.jaxpr.outvars
+            if getattr(v.aval, "weak_type", False)
+        )
+        cold = steady = None
+        if execute:
+            cold, steady = _measure_compiles(
+                lambda: step(state, batch, rng),
+                lambda out: step(out[0], batch, rng),
+            )
+        mesh_shape = {k: int(v) for k, v in mesh.shape.items()}
+        return Artifact(
+            name=f"train_{mode}",
+            kind="train",
+            parallel=spec.parallel,
+            mesh_shape=mesh_shape,
+            batch=int(batch.x.shape[0]),
+            seq_len=model_cfg.max_seq_len,
+            hlo_text=hlo,
+            stablehlo_text=stablehlo,
+            expected_donated=len(jax.tree.leaves(state)),
+            param_shapes=_sharded_param_shapes(
+                state.params, rules, mesh, min_size=model_cfg.d_model**2
+            ),
+            weak_outputs=weak,
+            n_layers=model_cfg.n_layers,
+            moe_experts=model_cfg.moe_experts,
+            compute_dtype=model_cfg.compute_dtype,
+            cold_compiles=cold,
+            steady_compiles=steady,
+            comm_estimate=comm_bytes_per_step(
+                model_cfg, int(batch.x.shape[0]), model_cfg.max_seq_len, mesh_shape,
+                spec.parallel,
+            ),
+        )
+
+
+def build_decode_artifact(*, execute: bool = True) -> Artifact:
+    """Lower + compile the greedy decode entry point (prefill + token scan
+    under one jit — the serving fast path of PR 4) on the default device.
+
+    Greedy is the audited flavor: it is the bench's continuity row and its
+    HLO must stay free of the sampling machinery. No donation is expected
+    (generate allocates its cache per call)."""
+    from dtc_tpu.generate import _generate_jit
+
+    model_cfg = audit_model_cfg()
+    model = GPT(model_cfg)
+    params = jax.jit(
+        lambda r, x: model.init({"params": r, "dropout": r}, x, train=False)
+    )(jax.random.PRNGKey(0), jnp.ones((1, model_cfg.max_seq_len), jnp.int32))[
+        "params"
+    ]
+    prompt = jnp.zeros((2, 4), jnp.int32)
+    args = (model, params, prompt, 8, jax.random.PRNGKey(1))
+    kwargs = dict(temperature=0.0)
+    lowered = _generate_jit.lower(*args, **kwargs)
+    stablehlo = lowered.as_text()
+    hlo = lowered.compile().as_text()
+    traced = _generate_jit.trace(*args, **kwargs)
+    weak = sum(
+        1 for v in traced.jaxpr.jaxpr.outvars
+        if getattr(v.aval, "weak_type", False)
+    )
+    cold = steady = None
+    if execute:
+        cold, steady = _measure_compiles(
+            lambda: _generate_jit(*args, **kwargs),
+            lambda _out: _generate_jit(*args, **kwargs),
+        )
+    return Artifact(
+        name="decode_greedy",
+        kind="decode",
+        parallel=None,
+        mesh_shape={},
+        batch=2,
+        seq_len=model_cfg.max_seq_len,
+        hlo_text=hlo,
+        stablehlo_text=stablehlo,
+        expected_donated=0,
+        param_shapes=_param_shapes(params),
+        weak_outputs=weak,
+        n_layers=model_cfg.n_layers,
+        moe_experts=0,
+        compute_dtype=model_cfg.compute_dtype,
+        cold_compiles=cold,
+        steady_compiles=steady,
+        comm_estimate=None,
+    )
+
+
+def build_artifacts(
+    modes: Sequence[str], *, decode: bool = False, execute: bool = True
+) -> list[Artifact]:
+    """Build artifacts for ``modes`` (+ the decode entry when asked)."""
+    arts = [build_train_artifact(m, execute=execute) for m in modes]
+    if decode:
+        arts.append(build_decode_artifact(execute=execute))
+    return arts

@@ -1,0 +1,297 @@
+"""The rule engine: severity-ranked findings over lowered artifacts.
+
+Five families, each encoding an invariant the paper's comparison (and the
+round-5 one-off tests) depend on:
+
+1. **collective census** — each mode must emit the collectives its design
+   requires (DP: gradient all-reduce; TP: activation all-reduce + param
+   all-gather; FSDP: param all-gather + grad reduce-scatter, accepting the
+   CPU backend's all-reduce+partition-id decomposition; EP/Ulysses:
+   all-to-all) and must NOT emit the replicate-and-slice fallbacks: a
+   full-parameter all-gather outside FSDP, a stacked-parameter all-gather
+   inside FSDP (ZeRO's memory win hoisted out of the layer scan), a
+   full-expert-tensor all-gather under EP. Census bytes are cross-checked
+   against ``utils/metrics.comm_bytes_per_step`` within a wide tolerance
+   (graph result-bytes vs ring wire-bytes differ by (n-1)/n-class factors
+   and CPU decomposition; outside 8x either way something is structurally
+   wrong — warn, the baselines pin the exact numbers).
+2. **donation audit** — every donated buffer must appear in the module's
+   ``input_output_alias`` map (the PR 1 out-shardings regression class:
+   GSPMD normalizes a degenerate out-spec, the signature stops matching,
+   the donation silently drops and peak memory doubles).
+3. **dtype/promotion audit** — no f64 anywhere (CPU silently defaults to
+   f64 for stray Python floats under x64; TPU would either crash or
+   emulate at 1/10 speed), no weak-typed outputs (weak types re-trace on
+   the next call — the canonicalize_state_placement bug class), and a
+   declared-bf16 model must actually lower bf16 matmuls.
+4. **host-sync lint** — no device round-trips inside the trainer's timed
+   loop outside sanctioned boundaries (see :mod:`hostsync`).
+5. **recompile fingerprint** — a compiled entry point executes from ONE
+   executable: cold exactly one backend compile, steady zero.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from dtc_tpu.analysis import hlo
+from dtc_tpu.analysis.hostsync import lint_file, unsanctioned
+from dtc_tpu.analysis.lowering import Artifact
+
+#: Finding severities, gate-relevant order. Only ``error`` fails the audit.
+SEVERITIES = ("error", "warn", "info")
+
+
+@dataclasses.dataclass
+class Finding:
+    rule: str        # family.check, e.g. "census.required_collective"
+    severity: str    # error | warn | info
+    artifact: str    # entry-point name, or "trainer" for the source lint
+    message: str
+
+    def as_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+#: Per-mode required collectives (presence; the baseline pins counts).
+#: FSDP's reduce-scatter is special-cased below for the CPU decomposition.
+REQUIRED_COLLECTIVES: dict[str, tuple[str, ...]] = {
+    "train_dp": ("all-reduce",),
+    "train_tp": ("all-reduce", "all-gather"),
+    "train_fsdp": ("all-gather",),
+    "train_ep": ("all-to-all",),
+    "train_ep_sort": ("all-to-all",),
+    "train_ulysses": ("all-to-all",),
+}
+
+#: Census-bytes vs comm_bytes_per_step cross-check tolerance (ratio band).
+CROSS_CHECK_BAND = (1 / 8, 8.0)
+
+
+def _err(rule: str, art: str, msg: str) -> Finding:
+    return Finding(rule, "error", art, msg)
+
+
+def _warn(rule: str, art: str, msg: str) -> Finding:
+    return Finding(rule, "warn", art, msg)
+
+
+# -- family 1: collective census ------------------------------------------
+
+def audit_census(a: Artifact) -> list[Finding]:
+    out: list[Finding] = []
+    census = hlo.collective_census(a.hlo_text)
+    counts = {op: row["count"] for op, row in census.items()}
+
+    for op in REQUIRED_COLLECTIVES.get(a.name, ()):
+        if counts.get(op, 0) == 0:
+            out.append(_err(
+                "census.required_collective", a.name,
+                f"{a.name} lost its {op}s — the partitioner fell back to a "
+                f"replicated program (census: {counts})",
+            ))
+    if a.name == "train_fsdp":
+        # ZeRO-3 gradient reduce-scatter: literal instruction, or the CPU
+        # pipeline's all-reduce + partition-id dynamic-slice decomposition.
+        # Demand the partition-id fingerprint so a plain replicated
+        # all-reduce (DP, not ZeRO) cannot pass.
+        if counts.get("reduce-scatter", 0) == 0 and not (
+            counts.get("all-reduce", 0) > 0 and hlo.has_partition_id(a.hlo_text)
+        ):
+            out.append(_err(
+                "census.required_collective", a.name,
+                "FSDP lost its gradient reduce-scatter (neither the literal "
+                f"instruction nor the all-reduce+partition-id decomposition "
+                f"is present; census: {counts})",
+            ))
+
+    out.extend(_audit_gathers(a))
+    out.extend(_cross_check_bytes(a, census))
+    return out
+
+
+def _audit_gathers(a: Artifact) -> list[Finding]:
+    """The forbidden-gather rules — replicate-and-slice fingerprints."""
+    out: list[Finding] = []
+    gathers = hlo.all_gather_dims(a.hlo_text)
+    param_shapes = {(d, dims) for d, dims in a.param_shapes if len(dims) >= 2}
+
+    if a.kind == "train" and a.parallel != "fsdp":
+        # "No full-parameter all-gather outside FSDP": a gather landing a
+        # buffer exactly shaped like the FULL form of a param that is
+        # declared SHARDED means the partitioner is rebuilding replicated
+        # weights every step. (Replicated params never enter
+        # ``param_shapes`` — their gradients are legitimately assembled
+        # by param-shaped gathers; see lowering._sharded_param_shapes.)
+        bad = [g for g in gathers if g in param_shapes]
+        if bad:
+            out.append(_err(
+                "census.full_param_gather", a.name,
+                f"full-parameter all-gather(s) outside FSDP: "
+                f"{[f'{d}{list(dims)}' for d, dims in bad[:4]]}",
+            ))
+    if a.parallel == "fsdp":
+        # Inside FSDP, per-layer rank-2 gathers at use are the design;
+        # a rank-3 gather with the stacked n_layers leading axis means XLA
+        # hoisted the whole parameter out of the layer scan and the ZeRO
+        # memory win is gone.
+        stacked = [
+            (d, dims) for d, dims in gathers
+            if len(dims) >= 3 and dims[0] == a.n_layers
+        ]
+        if stacked:
+            out.append(_err(
+                "census.stacked_param_gather", a.name,
+                "full stacked-parameter all-gather(s) outside the FSDP "
+                f"layer scan: {[f'{d}{list(dims)}' for d, dims in stacked[:4]]}",
+            ))
+    if a.moe_experts > 0:
+        # EP: a gather landing a full leading-E expert tensor (B,E,...) or
+        # (B,T,E,...) is the replicate-everything fallback the EP rule
+        # rows exist to prevent.
+        b, e = a.batch, a.moe_experts
+        bad = [
+            (d, dims) for d, dims in gathers
+            if d == "f32" and len(dims) >= 3 and dims[0] == b
+            and (dims[1] == e or (len(dims) >= 4 and dims[2] == e))
+        ]
+        if bad:
+            out.append(_err(
+                "census.expert_gather", a.name,
+                f"EP gathered full expert tensors: "
+                f"{[f'{d}{list(dims)}' for d, dims in bad[:4]]}",
+            ))
+    return out
+
+
+def _cross_check_bytes(a: Artifact, census: dict) -> list[Finding]:
+    """Census result-bytes vs the analytic comm_bytes_per_step estimate.
+
+    Wide-band sanity only (warn): the census sums per-instruction result
+    buffers while the estimator models ring wire traffic, and the CPU
+    backend decomposes reduce-scatter — but a DP mode whose all-reduce
+    bytes are 100x off the gradient estimate is structurally wrong in a
+    way the presence checks cannot see."""
+    est = a.comm_estimate or {}
+    checks: list[tuple[str, tuple[str, ...], float]] = []
+    if est.get("dp_allreduce"):
+        checks.append((
+            "dp_allreduce", ("all-reduce", "reduce-scatter", "all-gather"),
+            est["dp_allreduce"],
+        ))
+    if est.get("tp_allreduce"):
+        checks.append((
+            "tp_allreduce", ("all-reduce", "all-gather", "all-to-all"),
+            est["tp_allreduce"],
+        ))
+    out: list[Finding] = []
+    lo, hi = CROSS_CHECK_BAND
+    for label, ops, estimate in checks:
+        measured = float(sum(census.get(op, {}).get("bytes", 0) for op in ops))
+        if measured == 0:
+            continue  # presence checks already cover a missing collective
+        ratio = measured / estimate
+        if not (lo <= ratio <= hi):
+            out.append(_warn(
+                "census.bytes_cross_check", a.name,
+                f"{label}: census bytes {measured:.3e} vs "
+                f"comm_bytes_per_step estimate {estimate:.3e} "
+                f"(ratio {ratio:.2f} outside [{lo:.3f}, {hi:.1f}])",
+            ))
+    return out
+
+
+# -- family 2: donation audit ---------------------------------------------
+
+def audit_donation(a: Artifact) -> list[Finding]:
+    aliased = hlo.input_output_alias_count(a.hlo_text)
+    if a.expected_donated and aliased < a.expected_donated:
+        return [_err(
+            "donation.dropped", a.name,
+            f"{a.expected_donated} leaves donated but only {aliased} appear "
+            "in input_output_alias — XLA dropped donation(s); peak memory "
+            "doubles for every dropped buffer (PR 1 out-shardings bug class)",
+        )]
+    if aliased > a.expected_donated:
+        return [_warn(
+            "donation.unexpected", a.name,
+            f"{aliased} aliased buffers but only {a.expected_donated} "
+            "donated — the alias map covers something the entry point "
+            "never donated",
+        )]
+    return []
+
+
+# -- family 3: dtype / promotion audit ------------------------------------
+
+def audit_dtypes(a: Artifact) -> list[Finding]:
+    out: list[Finding] = []
+    f64 = hlo.count_dtype(a.hlo_text, "f64")
+    if f64:
+        out.append(_err(
+            "dtype.f64", a.name,
+            f"{f64} f64 buffer(s) in the compiled module — a Python-float "
+            "or x64 leak (TPU would emulate or reject)",
+        ))
+    if a.weak_outputs:
+        out.append(_err(
+            "dtype.weak_type", a.name,
+            f"{a.weak_outputs} weak-typed output(s) in the jaxpr — the next "
+            "call's signature will not match and the step recompiles "
+            "(canonicalize_state_placement bug class)",
+        ))
+    dots = hlo.dot_dtype_counts(a.stablehlo_text)
+    if a.compute_dtype == "bfloat16" and dots["bf16_dots"] == 0:
+        out.append(_err(
+            "dtype.bf16_region", a.name,
+            "model declares compute_dtype=bfloat16 but zero bf16 "
+            f"dot_generals were lowered ({dots}) — every matmul silently "
+            "upcast to f32",
+        ))
+    return out
+
+
+# -- family 4: host-sync lint ---------------------------------------------
+
+def audit_hostsync(path: str | None = None) -> list[Finding]:
+    """Lint the trainer source (or ``path``) for unsanctioned hot-loop
+    syncs. Source-level, so it is one finding list per file, not per
+    lowered artifact."""
+    sites = lint_file(path) if path else lint_file()
+    return [
+        _err(
+            "hostsync.hot_loop", "trainer",
+            f"{s.path}:{s.lineno}: {s.call} in the timed loop outside any "
+            f"sanctioned boundary ({s.code})",
+        )
+        for s in unsanctioned(sites)
+    ]
+
+
+# -- family 5: recompile fingerprint ---------------------------------------
+
+def audit_recompile(a: Artifact) -> list[Finding]:
+    out: list[Finding] = []
+    if a.steady_compiles is not None and a.steady_compiles > 0:
+        out.append(_err(
+            "recompile.steady", a.name,
+            f"second identical call compiled {a.steady_compiles} more "
+            "executable(s) — signature churn (shape/dtype/donation drift)",
+        ))
+    if a.cold_compiles is not None and a.cold_compiles > 1:
+        out.append(_err(
+            "recompile.cold", a.name,
+            f"first call compiled {a.cold_compiles} executables — the "
+            "double-compile class the obs watcher caught in PR 1 "
+            "(out_shardings no longer pin the state's shardings?)",
+        ))
+    return out
+
+
+def audit_artifact(a: Artifact) -> list[Finding]:
+    """All per-artifact rule families (1-3, 5; the source lint in family 4
+    is per-file — see :func:`audit_hostsync`)."""
+    return (
+        audit_census(a) + audit_donation(a) + audit_dtypes(a)
+        + audit_recompile(a)
+    )

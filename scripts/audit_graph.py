@@ -1,0 +1,128 @@
+#!/usr/bin/env python
+"""Graph auditor CLI: lower the real entry points, run the rule engine,
+gate on committed baselines.
+
+    # the CI pre-gate (scripts/verify_tier1.sh): ~2-3 min on CPU
+    JAX_PLATFORMS=cpu python scripts/audit_graph.py \
+        --modes dp,tp,fsdp,ep --check-baselines
+
+    # after an INTENDED graph change: re-bless, review the diff, commit
+    python scripts/audit_graph.py --modes dp,tp,fsdp,ep --decode \
+        --write-baseline
+
+Exit status: 0 iff no error-severity findings. The audit always runs on
+the 8-virtual-device CPU mesh (JAX_PLATFORMS honored, defaulting to cpu)
+so it needs no accelerator — committed baselines describe the CPU
+lowering of the exact programs the trainer runs; see README "Static
+analysis / graph audit".
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# Mesh env BEFORE jax imports: same 8-virtual-device layout (and thunk-
+# runtime workaround) the test suite pins in tests/conftest.py, so the
+# audited programs equal the tested programs.
+if "--xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8"
+        + " --xla_cpu_use_thunk_runtime=false"
+    )
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def main() -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument(
+        "--modes", default="dp,tp,fsdp,ep",
+        help="comma-separated train entry points (see analysis.lowering."
+        "TRAIN_ENTRIES); default: dp,tp,fsdp,ep",
+    )
+    p.add_argument(
+        "--decode", action="store_true",
+        help="also audit the greedy decode entry point",
+    )
+    p.add_argument(
+        "--check-baselines", action="store_true",
+        help="fail when a committed baseline is missing (drift always "
+        "checks against whatever baselines exist)",
+    )
+    p.add_argument(
+        "--write-baseline", action="store_true",
+        help="bless the current fingerprints as the committed baselines "
+        "instead of gating on them",
+    )
+    p.add_argument(
+        "--no-execute", action="store_true",
+        help="skip the two execution passes (faster; loses the "
+        "cold/steady recompile fingerprint)",
+    )
+    p.add_argument(
+        "--report", default="",
+        help="write the full JSON report to this path",
+    )
+    args = p.parse_args()
+
+    import jax
+
+    # The axon sitecustomize force-registers the TPU platform and
+    # overrides JAX_PLATFORMS at interpreter startup (tests/conftest.py);
+    # the audit is CPU-deterministic by design, so force it back.
+    if os.environ.get("JAX_PLATFORMS", "cpu") == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+
+    from dtc_tpu.analysis.lowering import TRAIN_ENTRIES, build_artifacts
+    from dtc_tpu.analysis.report import (
+        build_report, check_baselines, write_baselines,
+    )
+    from dtc_tpu.analysis.rules import audit_artifact, audit_hostsync
+
+    modes = [m for m in args.modes.split(",") if m]
+    unknown = [m for m in modes if m not in TRAIN_ENTRIES]
+    if unknown:
+        p.error(f"unknown modes {unknown}; known: {sorted(TRAIN_ENTRIES)}")
+
+    findings = []
+    artifacts = []
+    for art in build_artifacts(
+        modes, decode=args.decode, execute=not args.no_execute
+    ):
+        artifacts.append(art)
+        found = audit_artifact(art)
+        findings.extend(found)
+        errs = sum(1 for f in found if f.severity == "error")
+        print(f"[audit] {art.name}: lowered+compiled, "
+              f"{len(found)} finding(s) ({errs} error)")
+    findings.extend(audit_hostsync())
+
+    report = build_report(artifacts, findings)
+
+    if args.write_baseline:
+        for path in write_baselines(report):
+            print(f"[audit] baseline written: {path}")
+    else:
+        drift = check_baselines(report, require=args.check_baselines)
+        findings.extend(drift)
+        report = build_report(artifacts, findings)
+
+    if args.report:
+        with open(args.report, "w") as f:
+            json.dump(report, f, indent=1, sort_keys=True)
+        print(f"[audit] report: {args.report}")
+
+    for f in report["findings"]:
+        print(f"[{f['severity'].upper()}] {f['artifact']} {f['rule']}: "
+              f"{f['message']}")
+    errors = report["summary"].get("error", 0)
+    print(f"[audit] {len(report['entries'])} entry point(s), "
+          f"{errors} error(s), {report['summary'].get('warn', 0)} warning(s)")
+    return 1 if errors else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,140 @@
+#!/usr/bin/env python
+"""Diff a tier-1 pytest log against the committed known-env-failure
+manifest: exit nonzero only on NEW failures.
+
+    scripts/verify_tier1.sh            # writes /tmp/_t1.log
+    python scripts/diff_tier1.py /tmp/_t1.log
+
+The suite carries a block of failures that are jax-version/environment
+issues, not regressions (PP's PartitionId lowering on jax 0.4.37, golden
+fp drift, the 1-core multihost launch — see the manifest's ``note``).
+Eyeballing "are these 31 the SAME 31?" every round is exactly the kind of
+check that silently rots; this makes it mechanical:
+
+- ``new``   — in the log, not the manifest: a real regression, exit 1.
+- ``fixed`` — in the manifest, absent from a log that REACHED them: good
+  news, update the manifest (``--update`` rewrites it from the log).
+- not reached — the tier-1 command's 870 s timeout cuts the suite short
+  on slow hosts (rc=124); tests past the cut are neither new nor fixed.
+  Truncation is detected by the missing pytest end-of-session summary
+  line and reported, never treated as "everything else passed".
+
+No JAX import: this runs anywhere, on any captured log.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DEFAULT_MANIFEST = os.path.join(REPO, "tests", "known_env_failures.json")
+
+#: pytest short-summary lines; ERROR covers collection/setup errors.
+_FAIL_LINE = re.compile(r"^(?:FAILED|ERROR)\s+(\S+::\S+|\S+\.py)\s*(?:-|$)")
+
+#: End-of-session evidence. pytest's count line ("N failed, M passed in
+#: 12.34s") when present — but this env's piped `-q` logs drop it, so the
+#: `[100%]` progress marker is the primary signal: it only prints once the
+#: last collected test has run, and a timeout kill mid-suite never
+#: reaches it.
+_END_LINE = re.compile(
+    r"^=*\s*(?:\d+ (?:failed|passed|skipped|error|xfailed|xpassed|warning)"
+    r"s?,?\s*)+in\s+[\d.]+s?\b|no tests ran in"
+)
+_PROGRESS_END = re.compile(r"\[100%\]\s*$")
+
+
+def parse_failures(log_text: str) -> tuple[set[str], bool]:
+    """(failed test ids, log_is_complete)."""
+    failed = set()
+    complete = False
+    for line in log_text.splitlines():
+        m = _FAIL_LINE.match(line.strip())
+        if m:
+            failed.add(m.group(1))
+        if _END_LINE.match(line.strip().strip("= ")) or _PROGRESS_END.search(line):
+            complete = True
+    return failed, complete
+
+
+def load_manifest(path: str) -> dict:
+    with open(path) as f:
+        return json.load(f)
+
+
+def main() -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("log", nargs="?", default="/tmp/_t1.log",
+                   help="pytest log to parse (default: /tmp/_t1.log)")
+    p.add_argument("--manifest", default=DEFAULT_MANIFEST)
+    p.add_argument(
+        "--update", action="store_true",
+        help="rewrite the manifest's failure list from a COMPLETE log "
+        "(refused on a truncated one: unreached tests would be dropped)",
+    )
+    args = p.parse_args()
+
+    try:
+        with open(args.log) as f:
+            failed, complete = parse_failures(f.read())
+    except OSError as e:
+        print(f"diff_tier1: cannot read log: {e}", file=sys.stderr)
+        return 2
+    manifest = load_manifest(args.manifest)
+    known = set(manifest["failures"])
+
+    new = sorted(failed - known)
+    gone = sorted(known - failed)
+
+    if args.update:
+        if not complete:
+            print("diff_tier1: refusing --update from a truncated log "
+                  "(no pytest end-of-session summary found)", file=sys.stderr)
+            return 2
+        manifest["failures"] = sorted(failed)
+        # Refresh the provenance alongside the list: a manifest claiming
+        # its failures came from a commit/date they did not is worse
+        # than no manifest.
+        import datetime
+        import subprocess
+
+        manifest["captured"] = datetime.date.today().isoformat()
+        try:
+            manifest["commit"] = subprocess.run(
+                ["git", "-C", REPO, "rev-parse", "--short", "HEAD"],
+                capture_output=True, text=True, timeout=10, check=True,
+            ).stdout.strip()
+        except Exception:
+            manifest["commit"] = "unknown"
+        with open(args.manifest, "w") as f:
+            json.dump(manifest, f, indent=1)
+            f.write("\n")
+        print(f"diff_tier1: manifest updated ({len(failed)} failures, "
+              f"commit {manifest['commit']})")
+        return 0
+
+    print(f"diff_tier1: log={args.log} "
+          f"({'complete' if complete else 'TRUNCATED — tier-1 timeout/crash'})")
+    print(f"  failures in log: {len(failed)}  known-env: {len(known)}")
+    for t in new:
+        print(f"  NEW: {t}")
+    if gone:
+        label = "fixed" if complete else "fixed-or-not-reached"
+        for t in gone:
+            print(f"  {label}: {t}")
+        if complete:
+            print("  (all known failures accounted for? refresh with "
+                  "--update after verifying)")
+    if new:
+        print(f"diff_tier1: {len(new)} NEW failure(s) — regression")
+        return 1
+    print("diff_tier1: no new failures")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -3,15 +3,26 @@
 # CI, the driver, and humans all run the same gate). Exits non-zero on any
 # test failure; prints DOTS_PASSED=<n> for the no-worse-than-seed check.
 #
-# Pre-gate: the MoE-dispatch/HLO-collective suites (ISSUE 3) and the
-# decode fast-path surfaces (ISSUE 4: generate + metrics tests import
-# ops/decode_attention.py and the restructured models/gpt.py) must
+# Pre-gate 1: the MoE-dispatch/HLO-collective suites (ISSUE 3), the decode
+# fast-path surfaces (ISSUE 4), and the graph-auditor suite (ISSUE 5) must
 # COLLECT. The main run passes `--continue-on-collection-errors`, under
 # which an import error in one file still fails the run but buries the
 # cause at the bottom of a long log; failing fast here names the broken
 # file first. Collection is cheap (no tests execute).
 timeout -k 10 120 env JAX_PLATFORMS=cpu python -m pytest --collect-only -q -p no:cacheprovider \
   tests/test_moe.py tests/test_collectives_hlo.py \
-  tests/test_generate.py tests/test_metrics.py > /dev/null || {
-    echo "tier-1 pre-gate: MoE/HLO/decode test collection failed" >&2; exit 1; }
+  tests/test_generate.py tests/test_metrics.py tests/test_analysis.py > /dev/null || {
+    echo "tier-1 pre-gate: MoE/HLO/decode/analysis test collection failed" >&2; exit 1; }
+# Pre-gate 2 (ISSUE 5): the graph audit — lower/compile the dp/tp/fsdp/ep
+# train steps (8-virtual-device CPU mesh) AND the greedy decode scan, run
+# the rule engine (collective census, donation, dtype, host-sync lint,
+# recompile), and gate on ALL committed baselines under
+# dtc_tpu/analysis/baselines/. ~2-3 min on this 1-core host; runs
+# anywhere (JAX_PLATFORMS=cpu, no accelerator). On an INTENDED graph
+# change: re-bless with
+#   python scripts/audit_graph.py --modes dp,tp,fsdp,ep --decode --write-baseline
+# and commit the baseline diff.
+timeout -k 10 420 env JAX_PLATFORMS=cpu python scripts/audit_graph.py \
+  --modes dp,tp,fsdp,ep --decode --check-baselines || {
+    echo "tier-1 pre-gate: graph audit failed (see findings above)" >&2; exit 1; }
 set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc

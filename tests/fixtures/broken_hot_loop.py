@@ -1,0 +1,27 @@
+"""Deliberately-broken trainer lookalike for the host-sync lint tests.
+
+NEVER imported or executed — tests/test_analysis.py feeds this file's
+SOURCE to ``dtc_tpu.analysis.hostsync.lint_file``. Each naked sync below
+is one violation the lint must flag; the sanctioned block at the bottom
+must NOT be flagged (it sits behind a ``log_every`` boundary, the
+trainer's legitimate sync point)."""
+
+
+def broken_train(train_cfg, train_step, data_it, jax, state, key):
+    step = 0
+    losses = []
+    while step < train_cfg.steps:
+        step += 1
+        x, y = next(data_it)
+        state, loss = train_step(state, (x, y), key)
+        # VIOLATION 1: per-step device fetch — serializes async dispatch.
+        losses.append(float(jax.device_get(loss)))
+        # VIOLATION 2: per-step blocking sync with no sanctioning boundary.
+        jax.block_until_ready(state)
+        # VIOLATION 3: scalar fetch.
+        if loss.item() > 1e4:
+            break
+        # Sanctioned: the log boundary is where syncs belong.
+        if step % train_cfg.log_every == 0:
+            print(step, jax.device_get(loss))
+    return losses

@@ -1,0 +1,346 @@
+"""Graph auditor tests (ISSUE 5).
+
+Two layers, matching the subsystem's own split:
+
+- **Rule engine on fabricated evidence** (fast, no compile): a
+  deliberately-broken artifact/fixture per rule family — full-parameter
+  all-gather, dropped donation, f64 + weak-type + vanished-bf16 leaks,
+  hot-loop host sync, cold/steady recompile — proving each family TRIPS,
+  plus parser unit tests on hand-written HLO text and a baseline
+  drift-gate round-trip in a tmp dir.
+- **Green path on the real programs** (`slow`: ~30-50 s of XLA compile per
+  mode on this 1-core host): dp/tp/fsdp/ep lower through the registry,
+  audit clean, and match the committed baselines — the same check
+  scripts/verify_tier1.sh runs as its pre-gate via audit_graph.py, kept
+  out of the 870 s tier-1 window by the marker.
+"""
+
+import dataclasses
+import json
+import os
+
+import pytest
+
+from dtc_tpu.analysis import hlo
+from dtc_tpu.analysis.hostsync import TRAINER_PATH, lint_file, unsanctioned
+from dtc_tpu.analysis.lowering import Artifact
+from dtc_tpu.analysis.report import check_baselines, write_baselines
+from dtc_tpu.analysis.rules import audit_artifact, audit_hostsync
+
+FIXTURE = os.path.join(os.path.dirname(__file__), "fixtures", "broken_hot_loop.py")
+
+# A minimal healthy DP-shaped artifact; each breaking test replaces one
+# piece of evidence. The HLO header carries 2 alias entries for the 2
+# "donated leaves"; the body carries the gradient all-reduce DP requires.
+_HEADER = (
+    "HloModule jit_train_step, is_scheduled=true, "
+    "input_output_alias={ {0}: (0, {}, may-alias), {1}: (1, {}, may-alias) }, "
+    "entry_computation_layout={()->()}\n"
+)
+_BODY = (
+    "  %all-reduce.1 = f32[64,128]{1,0} all-reduce(%p0), replica_groups={}\n"
+    "  %all-reduce.2 = (f32[64]{0}, f32[64]{0}) all-reduce(%a, %b)\n"
+)
+_STABLEHLO = (
+    "  %0 = stablehlo.dot_general ... : (tensor<8x64xf32>, tensor<64x128xf32>)"
+    " -> tensor<8x128xf32>\n"
+)
+
+
+def _artifact(**over) -> Artifact:
+    base = dict(
+        name="train_dp",
+        kind="train",
+        parallel="dp",
+        mesh_shape={"pipe": 1, "data": 8, "model": 1},
+        batch=8,
+        seq_len=32,
+        hlo_text=_HEADER + _BODY,
+        stablehlo_text=_STABLEHLO,
+        expected_donated=2,
+        param_shapes=[("f32", (4, 64, 128))],
+        weak_outputs=0,
+        n_layers=4,
+        moe_experts=0,
+        compute_dtype="float32",
+        cold_compiles=1,
+        steady_compiles=0,
+        comm_estimate=None,
+    )
+    base.update(over)
+    return Artifact(**base)
+
+
+def _errors(findings, rule_prefix=""):
+    return [
+        f for f in findings
+        if f.severity == "error" and f.rule.startswith(rule_prefix)
+    ]
+
+
+# --------------------------------------------------------------------------
+# hlo.py parsers on hand-written text
+# --------------------------------------------------------------------------
+
+def test_census_counts_and_tuple_bytes():
+    census = hlo.collective_census(_HEADER + _BODY)
+    assert census["all-reduce"]["count"] == 2
+    # 64*128*4 + (64 + 64)*4 — the tuple result sums its element buffers.
+    assert census["all-reduce"]["bytes"] == 64 * 128 * 4 + 2 * 64 * 4
+
+
+def test_alias_count_parses_header():
+    assert hlo.input_output_alias_count(_HEADER + _BODY) == 2
+    assert hlo.input_output_alias_count("HloModule bare\n" + _BODY) == 0
+
+
+def test_all_gather_shapes_format():
+    txt = "%ag = f32[8,32,64]{2,1,0} all-gather(%x), dimensions={0}\n"
+    assert hlo.all_gather_shapes(txt) == ["f32[8,32,64]"]
+    assert hlo.all_gather_dims(txt) == [("f32", (8, 32, 64))]
+
+
+def test_dot_dtype_counts():
+    txt = (
+        "  %0 = stablehlo.dot_general : tensor<8x64xbf16>\n"
+        "  %1 = stablehlo.dot_general : tensor<8x64xf32>\n"
+        "  %2 = stablehlo.add : tensor<8x64xf32>\n"
+    )
+    assert hlo.dot_dtype_counts(txt) == {"bf16_dots": 1, "f32_dots": 1}
+
+
+# --------------------------------------------------------------------------
+# family 1: collective census
+# --------------------------------------------------------------------------
+
+def test_healthy_artifact_is_clean():
+    assert audit_artifact(_artifact()) == []
+
+
+def test_missing_required_collective_trips():
+    a = _artifact(hlo_text=_HEADER)  # no all-reduce: DP fell back
+    assert _errors(audit_artifact(a), "census.required_collective")
+
+
+def test_full_param_gather_trips_outside_fsdp():
+    # A gather landing the FULL stacked shape of a sharded param.
+    body = "%ag = f32[4,64,128]{2,1,0} all-gather(%w), dimensions={1}\n"
+    a = _artifact(hlo_text=_HEADER + _BODY + body)
+    assert _errors(audit_artifact(a), "census.full_param_gather")
+
+
+def test_stacked_param_gather_trips_inside_fsdp():
+    body = (
+        "%ar = f32[1]{0} all-reduce(%g)\n  %pid = u32[] partition-id()\n"
+        "%ag1 = f32[64,128]{1,0} all-gather(%w1)\n"   # per-layer: fine
+        "%ag2 = f32[4,64,128]{2,1,0} all-gather(%w2)\n"  # stacked: hoisted
+    )
+    a = _artifact(
+        name="train_fsdp", parallel="fsdp", hlo_text=_HEADER + body
+    )
+    found = audit_artifact(a)
+    assert _errors(found, "census.stacked_param_gather")
+    # The per-layer rank-2 gather alone is the healthy shape.
+    healthy = _artifact(
+        name="train_fsdp", parallel="fsdp",
+        hlo_text=_HEADER + body.replace(
+            "%ag2 = f32[4,64,128]{2,1,0} all-gather(%w2)\n", ""
+        ),
+    )
+    assert not _errors(audit_artifact(healthy))
+
+
+def test_expert_gather_trips_under_ep():
+    body = (
+        "%a2a = f32[8,2,16,64]{3,2,1,0} all-to-all(%x)\n"
+        "%ag = f32[8,4,16,64]{3,2,1,0} all-gather(%e)\n"  # full E=4 tensor
+    )
+    a = _artifact(
+        name="train_ep", parallel="3d", moe_experts=4,
+        hlo_text=_HEADER + _BODY + body,
+    )
+    assert _errors(audit_artifact(a), "census.expert_gather")
+
+
+def test_bytes_cross_check_warns_when_far_off():
+    a = _artifact(comm_estimate={"dp_allreduce": 1e12, "total": 1e12})
+    found = audit_artifact(a)
+    warns = [f for f in found if f.rule == "census.bytes_cross_check"]
+    assert warns and warns[0].severity == "warn"
+    # And errors stay zero: the cross-check never fails the gate.
+    assert not _errors(found)
+
+
+# --------------------------------------------------------------------------
+# family 2: donation audit
+# --------------------------------------------------------------------------
+
+def test_dropped_donation_trips():
+    a = _artifact(expected_donated=3)  # header only aliases 2
+    assert _errors(audit_artifact(a), "donation.dropped")
+
+
+# --------------------------------------------------------------------------
+# family 3: dtype / promotion audit
+# --------------------------------------------------------------------------
+
+def test_f64_leak_trips():
+    a = _artifact(hlo_text=_HEADER + _BODY + "%c = f64[8]{0} convert(%x)\n")
+    assert _errors(audit_artifact(a), "dtype.f64")
+
+
+def test_weak_type_leak_trips():
+    assert _errors(audit_artifact(_artifact(weak_outputs=1)), "dtype.weak_type")
+
+
+def test_vanished_bf16_region_trips():
+    # Declared-bf16 model whose StableHLO has only f32 dots: every matmul
+    # silently upcast.
+    a = _artifact(compute_dtype="bfloat16")
+    assert _errors(audit_artifact(a), "dtype.bf16_region")
+    healthy = _artifact(
+        compute_dtype="bfloat16",
+        stablehlo_text=_STABLEHLO.replace("xf32", "xbf16"),
+    )
+    assert not _errors(audit_artifact(healthy), "dtype.bf16_region")
+
+
+# --------------------------------------------------------------------------
+# family 4: host-sync lint
+# --------------------------------------------------------------------------
+
+def test_hot_loop_sync_lint_trips_on_fixture():
+    sites = lint_file(FIXTURE)
+    bad = unsanctioned(sites)
+    # The three naked syncs, and ONLY them — the log_every-guarded fetch
+    # is sanctioned.
+    assert sorted(s.call for s in bad) == [
+        "block_until_ready", "device_get", "item",
+    ]
+    sanctioned = [s for s in sites if s.sanctioned]
+    assert sanctioned and all("log_every" in s.boundary for s in sanctioned)
+    # And the engine surfaces them as error findings.
+    assert len(audit_hostsync(FIXTURE)) == 3
+
+
+def test_else_branch_of_boundary_if_is_not_sanctioned():
+    """The else of a log_every `if` runs on every NON-boundary step — a
+    sync there is the per-step regression the lint hunts, and must not
+    inherit the boundary's sanction (review finding, this PR)."""
+    from dtc_tpu.analysis.hostsync import lint_source
+
+    src = (
+        "def f(cfg, jax, loss):\n"
+        "    step = 0\n"
+        "    while step < cfg.steps:\n"
+        "        step += 1\n"
+        "        if step % cfg.log_every == 0:\n"
+        "            jax.device_get(loss)\n"
+        "        else:\n"
+        "            jax.block_until_ready(loss)\n"
+    )
+    sites = {s.call: s.sanctioned for s in lint_source(src)}
+    assert sites == {"device_get": True, "block_until_ready": False}
+
+
+def test_trainer_hot_loop_is_clean():
+    """The real trainer's timed loop syncs only at sanctioned boundaries
+    — the permanent form of the 'loss fetched at log boundaries only'
+    design claim in train/trainer.py's module doc."""
+    sites = lint_file(TRAINER_PATH)
+    assert unsanctioned(sites) == [], [
+        f"{s.path}:{s.lineno} {s.code}" for s in unsanctioned(sites)
+    ]
+    # The loop DOES sync somewhere (the boundary fetches) — if the lint
+    # suddenly sees zero sites it is parsing the wrong loop, not passing.
+    assert sites, "lint found no sync sites at all in the trainer hot loop"
+
+
+# --------------------------------------------------------------------------
+# family 5: recompile fingerprint
+# --------------------------------------------------------------------------
+
+def test_steady_recompile_trips():
+    assert _errors(audit_artifact(_artifact(steady_compiles=1)), "recompile.steady")
+
+
+def test_cold_double_compile_trips():
+    assert _errors(audit_artifact(_artifact(cold_compiles=2)), "recompile.cold")
+
+
+# --------------------------------------------------------------------------
+# baseline drift gate
+# --------------------------------------------------------------------------
+
+def _report(a: Artifact) -> dict:
+    from dtc_tpu.analysis.report import build_report
+
+    return build_report([a], [])
+
+
+def test_baseline_roundtrip_and_drift(tmp_path):
+    d = str(tmp_path)
+    rep = _report(_artifact())
+    write_baselines(rep, d)
+    assert check_baselines(rep, d) == []  # same graph: clean
+    # Drift: one extra all-reduce (count + bytes change).
+    drifted = _report(_artifact(hlo_text=_HEADER + _BODY + _BODY))
+    findings = check_baselines(drifted, d)
+    assert [f.rule for f in findings] == ["baseline.drift"]
+    assert findings[0].severity == "error"
+    assert "census.all-reduce.count" in findings[0].message
+
+
+def test_baseline_missing_and_env_mismatch(tmp_path):
+    d = str(tmp_path)
+    rep = _report(_artifact())
+    missing = check_baselines(rep, d, require=True)
+    assert [f.rule for f in missing] == ["baseline.missing"]
+    assert missing[0].severity == "error"
+    assert check_baselines(rep, d, require=False)[0].severity == "warn"
+    # A baseline blessed under another jax: drift downgraded to warn.
+    write_baselines(rep, d)
+    path = os.path.join(d, "train_dp.json")
+    blessed = json.load(open(path))
+    blessed["jax"] = "9.9.9"
+    json.dump(blessed, open(path, "w"))
+    drifted = _report(_artifact(hlo_text=_HEADER + _BODY + _BODY))
+    findings = check_baselines(drifted, d)
+    assert findings[0].severity == "warn"
+
+
+# --------------------------------------------------------------------------
+# green path: the real lowered programs match their committed baselines
+# --------------------------------------------------------------------------
+
+@pytest.mark.slow
+@pytest.mark.parametrize("mode", ["dp", "tp", "fsdp", "ep"])
+def test_green_path_matches_committed_baseline(mode):
+    """The acceptance run, per mode: lower/compile the real step, audit
+    clean, fingerprint equal to the committed baseline. `slow`: each mode
+    is ~30-50 s of XLA compile on this host; scripts/verify_tier1.sh runs
+    the same check for all four modes as its audit_graph.py pre-gate."""
+    from dtc_tpu.analysis.lowering import build_train_artifact
+    from dtc_tpu.analysis.report import build_report
+
+    art = build_train_artifact(mode, execute=True)
+    findings = audit_artifact(art)
+    assert not _errors(findings), [f.message for f in findings]
+    drift = check_baselines(build_report([art], findings))
+    assert not drift, [f.message for f in drift]
+
+
+@pytest.mark.slow
+def test_green_path_decode_matches_committed_baseline():
+    """Same acceptance check for the greedy decode entry point — the
+    serving path's graph (no sampling machinery, no donation, one
+    executable) is baselined too, and verify_tier1.sh's pre-gate audits
+    it with --decode."""
+    from dtc_tpu.analysis.lowering import build_decode_artifact
+    from dtc_tpu.analysis.report import build_report
+
+    art = build_decode_artifact(execute=True)
+    findings = audit_artifact(art)
+    assert not _errors(findings), [f.message for f in findings]
+    drift = check_baselines(build_report([art], findings))
+    assert not drift, [f.message for f in drift]

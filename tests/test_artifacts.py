@@ -103,3 +103,57 @@ def test_tpu_dp_bench_sidecar_consistent_with_log():
         "sustained window should undercut the stall-contaminated cumulative "
         "average; if this flips the artifact story is stale"
     )
+
+
+def _bench_file(path, detail: dict | None, malformed: bool = False) -> None:
+    """Write one committed-BENCH-shaped wrapper file (the real files wrap
+    the run's stdout tail; the detail dict rides the '# bench-detail:'
+    line — see bench._bench_detail)."""
+    import json
+
+    if malformed:
+        body = {"tail": ["not", "a", "string"]}
+    elif detail is None:
+        body = {"n": 1, "rc": 0, "tail": "no detail line here\n"}
+    else:
+        body = {"n": 1, "rc": 0, "tail": "# bench-detail: " + json.dumps(detail)}
+    with open(path, "w") as f:
+        json.dump(body, f)
+
+
+def test_decode_drift_guard_degrades_gracefully(tmp_path, capsys):
+    """ISSUE 5 satellite: the guard must warn — never raise, never flag —
+    when NO committed BENCH file carries decode rows, fall back past a
+    decode-less newest file to an older one that has them, and still
+    catch a real >20% ms/token regression against that fallback."""
+    from bench import decode_drift_guard
+
+    d = str(tmp_path)
+    run = {"decode_b8": {"ms_per_token": 10.0}, "devices": 1}
+
+    # No BENCH files at all: silent no-op.
+    assert decode_drift_guard(dict(run), d) == []
+
+    # Files exist but none carry decode rows (one malformed for good
+    # measure): warn, return [], raise nothing.
+    _bench_file(os.path.join(d, "BENCH_r01.json"), {"moe_e8": {"mfu": 0.3}})
+    _bench_file(os.path.join(d, "BENCH_r02.json"), None, malformed=True)
+    extra = dict(run)
+    assert decode_drift_guard(extra, d) == []
+    assert "no committed BENCH" in capsys.readouterr().out
+    assert "decode_regressions" not in extra
+
+    # An OLDER file gains decode rows; the newest still has none — the
+    # guard degrades to the newest file WITH rows instead of going blind.
+    _bench_file(
+        os.path.join(d, "BENCH_r01.json"),
+        {"decode_b8": {"ms_per_token": 5.0}},
+    )
+    extra = dict(run)  # 10.0 vs 5.0 = +100%: flag
+    flags = decode_drift_guard(extra, d)
+    assert len(flags) == 1 and "BENCH_r01.json" in flags[0]
+    assert extra["decode_regressions"] == flags
+
+    # Within the 20% band: clean.
+    extra = {"decode_b8": {"ms_per_token": 5.5}}
+    assert decode_drift_guard(extra, d) == []

@@ -2,12 +2,14 @@
 
 Loss-parity tests prove the parallel steps compute the right numbers;
 these prove they compute them the intended WAY: each strategy's step is
-``.lower().compile()``-ed on the 8-virtual-device CPU mesh (the
-test_pp_1f1b.py:80-125 pattern) and the optimized module is searched for
-the collectives the design requires — and for the ones it must NOT
-contain. A partitioner regression that silently falls back to
-replicate-and-slice (correct numbers, catastrophic memory/comm) fails
-here, not on a future TPU bill.
+lowered/compiled on the 8-virtual-device CPU mesh THROUGH THE SHARED
+ANALYSIS ENGINE (``dtc_tpu.analysis.lowering.compiled_train_hlo`` — the
+same trainer-faithful lowering the graph auditor baselines, so these
+one-off assertions and the permanent audit cannot drift apart) and the
+optimized module is searched for the collectives the design requires —
+and for the ones it must NOT contain. A partitioner regression that
+silently falls back to replicate-and-slice (correct numbers, catastrophic
+memory/comm) fails here, not on a future TPU bill.
 
 Backend note: XLA's CPU pipeline DECOMPOSES reduce-scatter into
 all-reduce + partition-id-indexed dynamic-slice, so the FSDP assertion
@@ -17,47 +19,17 @@ and all-gather survive as first-class instructions.
 
 import dataclasses
 import re
-from collections import Counter
 
-import jax
-import jax.numpy as jnp
 import pytest
-from flax import linen as nn
 
-from dtc_tpu.config.schema import MeshConfig, ModelConfig
-from dtc_tpu.models.gpt import GPT
-from dtc_tpu.parallel.mesh import mesh_from_config
-from dtc_tpu.parallel.sharding import DEFAULT_RULES, FSDP_RULES, ring_rules_from
-from dtc_tpu.train.train_step import Batch, create_train_step
-from dtc_tpu.train.trainer import init_state
-from tests.conftest import make_train_cfg
-
-# One instruction per line in HLO text: "%name = <type> <op>(".  The type
-# can be a tuple (contains spaces), so match lazily up to the op name.
-_INSTR = re.compile(
-    r"%[\w.-]+ = .*? (all-to-all|all-gather|all-reduce|reduce-scatter|"
-    r"collective-permute)\("
+from dtc_tpu.analysis.hlo import (
+    all_gather_shapes,
+    collective_counts,
+    has_partition_id,
 )
-
-
-def _compiled_text(parallel, mesh_cfg, model_cfg, opt_cfg, rules):
-    mesh = mesh_from_config(parallel, mesh_cfg)
-    model = GPT(model_cfg)
-    tc = make_train_cfg(parallel, mesh=mesh_cfg)
-    with mesh, nn.logical_axis_rules(rules):
-        state = init_state(model, model_cfg, tc, opt_cfg, mesh, rules)
-        step = create_train_step(mesh, model=model, state=state)
-        x = jnp.zeros((tc.batch, model_cfg.max_seq_len), jnp.int32)
-        lowered = step.lower(state, Batch(x=x, y=x), jax.random.PRNGKey(0))
-        return lowered.compile().as_text()
-
-
-def _collectives(txt) -> Counter:
-    return Counter(_INSTR.findall(txt))
-
-
-def _all_gather_shapes(txt) -> list[str]:
-    return re.findall(r"%[\w.-]+ = ([\w\[\],]+)[^=]*? all-gather\(", txt)
+from dtc_tpu.analysis.lowering import compiled_train_hlo
+from dtc_tpu.config.schema import MeshConfig
+from dtc_tpu.parallel.sharding import DEFAULT_RULES, FSDP_RULES, ring_rules_from
 
 
 def test_ulysses_step_emits_all_to_all(tiny_model_cfg, opt_cfg):
@@ -65,11 +37,11 @@ def test_ulysses_step_emits_all_to_all(tiny_model_cfg, opt_cfg):
     all-to-alls vanish, the partitioner fell back to gathering the full
     sequence — numerically identical, defeats the whole scheme."""
     cfg = dataclasses.replace(tiny_model_cfg, attention="ulysses")
-    txt = _compiled_text(
+    txt = compiled_train_hlo(
         "3d", MeshConfig(pipe=1, data=2, model=4), cfg, opt_cfg,
         ring_rules_from(DEFAULT_RULES),
     )
-    c = _collectives(txt)
+    c = collective_counts(txt)
     assert c["all-to-all"] > 0, f"ulysses lost its all-to-alls: {dict(c)}"
 
 
@@ -83,10 +55,10 @@ def test_ep_moe_step_emits_all_to_all(tiny_model_cfg, opt_cfg, dispatch):
         tiny_model_cfg, moe_experts=4, moe_top_k=2, moe_capacity_factor=2.0,
         moe_dispatch=dispatch,
     )
-    txt = _compiled_text(
+    txt = compiled_train_hlo(
         "3d", MeshConfig(pipe=1, data=4, model=2), cfg, opt_cfg, DEFAULT_RULES
     )
-    c = _collectives(txt)
+    c = collective_counts(txt)
     assert c["all-to-all"] > 0, f"EP[{dispatch}] lost its all-to-alls: {dict(c)}"
     # The expert FFN einsums must contract EP-locally: a (B,T,E,cap)- or
     # (B,E,cap,ff)-shaped ALL-GATHER would mean the partitioner gathered
@@ -95,7 +67,7 @@ def test_ep_moe_step_emits_all_to_all(tiny_model_cfg, opt_cfg, dispatch):
     # tensor is the replicate-everything fallback.
     e, b = 4, 8
     bad = [
-        s for s in _all_gather_shapes(txt)
+        s for s in all_gather_shapes(txt)
         if re.match(rf"f32\[{b},{e},", s) or re.match(rf"f32\[{b},\d+,{e},", s)
     ]
     assert not bad, f"EP[{dispatch}] gathered full expert tensors: {bad}"
@@ -107,11 +79,11 @@ def test_fsdp_step_all_gathers_and_reduce_scatters(tiny_model_cfg, opt_cfg):
     dynamic-slice) on the CPU backend — accept either form, but demand
     the partition-id fingerprint so a plain replicated all-reduce (DP,
     not ZeRO) cannot pass."""
-    txt = _compiled_text("fsdp", MeshConfig(), tiny_model_cfg, opt_cfg, FSDP_RULES)
-    c = _collectives(txt)
+    txt = compiled_train_hlo("fsdp", MeshConfig(), tiny_model_cfg, opt_cfg, FSDP_RULES)
+    c = collective_counts(txt)
     assert c["all-gather"] > 0, f"FSDP lost its param all-gathers: {dict(c)}"
     assert c["reduce-scatter"] > 0 or (
-        c["all-reduce"] > 0 and "partition-id" in txt
+        c["all-reduce"] > 0 and has_partition_id(txt)
     ), f"FSDP lost its gradient reduce-scatter (or decomposition): {dict(c)}"
     # Forbidden: a FULL stacked-parameter all-gather outside the layer
     # scan. Inside the scan each layer's (d, d_ff)-class kernel gathers
@@ -119,5 +91,5 @@ def test_fsdp_step_all_gathers_and_reduce_scatters(tiny_model_cfg, opt_cfg):
     # leading axis means XLA hoisted the whole parameter out of the scan
     # and the ZeRO memory win is gone.
     L = tiny_model_cfg.n_layers
-    stacked = [s for s in _all_gather_shapes(txt) if re.match(rf"f32\[{L},\d+,\d+\]", s)]
+    stacked = [s for s in all_gather_shapes(txt) if re.match(rf"f32\[{L},\d+,\d+\]", s)]
     assert not stacked, f"full stacked-param all-gathers outside the scan: {stacked}"
