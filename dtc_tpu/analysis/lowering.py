@@ -372,11 +372,90 @@ def build_decode_artifact(*, execute: bool = True) -> Artifact:
     )
 
 
+def build_serve_artifact(*, execute: bool = True) -> Artifact:
+    """Lower + compile the SERVING decode step — the continuous-batching
+    iteration ``dtc_tpu/serve/engine.py`` drives over its fixed slot batch
+    (per-slot ``(B,)`` cache frontiers, greedy argmax, finite flag).
+
+    The recompile fingerprint is the serving runtime's core compiled-shape
+    invariant: between the two measured step executions a request is
+    ADMITTED into a slot (prefill + jitted cache-surgery insert, both
+    pre-warmed so only the audited step is counted) and the batch goes
+    from one active slot to two — admitting/evicting requests at fixed
+    slots must reuse the ONE executable (cold==1, steady==0), or serving
+    latency grows a compile stall on every arrival."""
+    from dtc_tpu.config.schema import ServeConfig
+    from dtc_tpu.serve.engine import ServingEngine
+    from dtc_tpu.serve.request import Request
+
+    model_cfg = audit_model_cfg()
+    model = GPT(model_cfg)
+    params = jax.jit(
+        lambda r, x: model.init({"params": r, "dropout": r}, x, train=False)
+    )(jax.random.PRNGKey(0), jnp.ones((1, model_cfg.max_seq_len), jnp.int32))[
+        "params"
+    ]
+    scfg = ServeConfig(slots=2, page_size=8, queue_depth=8, max_new_tokens=4,
+                       prefill_bucket=8)
+    eng = ServingEngine(model, params, scfg)
+    toks = jnp.zeros((scfg.slots,), jnp.int32)
+    args = (params, eng.cache, toks)
+    lowered = eng._step_fn.lower(*args)
+    stablehlo = lowered.as_text()
+    hlo = lowered.compile().as_text()
+    traced = eng._step_fn.trace(*args)
+    weak = sum(
+        1 for v in traced.jaxpr.jaxpr.outvars
+        if getattr(v.aval, "weak_type", False)
+    )
+    cold = steady = None
+    if execute:
+        # Warm every helper an admission runs (prefill/insert/fingerprint)
+        # so the measured window isolates the decode step itself.
+        eng.submit(Request(rid="warm", prompt=[1, 2, 3], max_new_tokens=1))
+        eng.run(max_steps=8)
+
+        def call_once():
+            eng.submit(Request(rid="a", prompt=[1, 2, 3], max_new_tokens=4))
+            eng.step()  # admits "a", decodes — the step's ONE compile
+            return eng.cache
+
+        def call_again(_):
+            eng.submit(Request(rid="b", prompt=[4, 5], max_new_tokens=4))
+            eng.step()  # admits "b" mid-flight: same executable, batch 1->2
+            return eng.cache
+
+        cold, steady = _measure_compiles(call_once, call_again)
+    return Artifact(
+        name="serve_decode",
+        kind="serve",
+        parallel=None,
+        mesh_shape={},
+        batch=scfg.slots,
+        seq_len=model_cfg.max_seq_len,
+        hlo_text=hlo,
+        stablehlo_text=stablehlo,
+        expected_donated=0,
+        param_shapes=_param_shapes(params),
+        weak_outputs=weak,
+        n_layers=model_cfg.n_layers,
+        moe_experts=0,
+        compute_dtype=model_cfg.compute_dtype,
+        cold_compiles=cold,
+        steady_compiles=steady,
+        comm_estimate=None,
+    )
+
+
 def build_artifacts(
-    modes: Sequence[str], *, decode: bool = False, execute: bool = True
+    modes: Sequence[str], *, decode: bool = False, serve: bool = False,
+    execute: bool = True
 ) -> list[Artifact]:
-    """Build artifacts for ``modes`` (+ the decode entry when asked)."""
+    """Build artifacts for ``modes`` (+ the decode/serve entries when
+    asked)."""
     arts = [build_train_artifact(m, execute=execute) for m in modes]
     if decode:
         arts.append(build_decode_artifact(execute=execute))
+    if serve:
+        arts.append(build_serve_artifact(execute=execute))
     return arts

@@ -2,15 +2,22 @@ from dtc_tpu.config.schema import (
     MeshConfig,
     ModelConfig,
     OptimConfig,
+    ServeConfig,
     TrainConfig,
 )
-from dtc_tpu.config.loader import load_config, load_yaml_dataclass
+from dtc_tpu.config.loader import (
+    load_config,
+    load_serve_config,
+    load_yaml_dataclass,
+)
 
 __all__ = [
     "MeshConfig",
     "ModelConfig",
     "OptimConfig",
+    "ServeConfig",
     "TrainConfig",
     "load_config",
+    "load_serve_config",
     "load_yaml_dataclass",
 ]

@@ -60,6 +60,33 @@ def load_yaml_dataclass(path: str | Path, cls: Type[T], overrides: dict[str, Any
     return _build(cls, data, str(path))
 
 
+def load_serve_config(
+    serve_config_path: str | Path,
+    model_config_path: str | Path | None = None,
+    serve_overrides: dict[str, Any] | None = None,
+    model_overrides: dict[str, Any] | None = None,
+):
+    """Load the (serve, model) config pair for the serving runtime.
+
+    The model config path defaults to a sibling ``model_config.yaml`` —
+    the same convention as :func:`load_config` — so a serving deployment
+    points at exactly the model file the training run used.
+    """
+    from dtc_tpu.config.schema import ModelConfig, ServeConfig
+
+    serve_config_path = Path(serve_config_path)
+    model_config_path = Path(
+        model_config_path or serve_config_path.parent / "model_config.yaml"
+    )
+    serve_cfg = load_yaml_dataclass(
+        serve_config_path, ServeConfig, overrides=serve_overrides
+    )
+    model_cfg = load_yaml_dataclass(
+        model_config_path, ModelConfig, overrides=model_overrides
+    )
+    return serve_cfg, model_cfg
+
+
 def load_config(
     train_config_path: str | Path,
     model_config_path: str | Path | None = None,

@@ -275,19 +275,28 @@ class WatchdogConfig:
 class StreamRetryConfig:
     """Self-healing data stream (``dtc_tpu/resilience/retry.py``): transient
     HF-streaming faults re-open the source at the exact consumed position
-    (``ds.skip``) with exponential backoff + jitter, bounded attempts."""
+    (``ds.skip``) with exponential backoff + jitter, bounded attempts.
+    Also the generic retry-knob block for serving-side transient faults
+    (``dtc_tpu/serve/``, via :func:`dtc_tpu.resilience.retry.retry_call`)."""
 
     enabled: bool = True
     max_attempts: int = 5        # consecutive failures before DataStreamError
     backoff_s: float = 1.0       # first-retry delay; doubles per attempt
     backoff_max_s: float = 30.0
     jitter: float = 0.1          # +/- fraction of the delay
+    # Hard wall-clock cap on ONE fault episode (consecutive failures +
+    # their backoffs). 0 = unbounded (legacy): max_attempts alone lets a
+    # stalled dependency hold the consumer for attempts x backoff_max_s,
+    # and nothing in the config says how long that is in seconds.
+    max_elapsed_s: float = 0.0
 
     def __post_init__(self) -> None:
         if self.max_attempts < 1:
             raise ValueError("max_attempts must be >= 1")
         if self.backoff_s < 0 or self.backoff_max_s < 0 or self.jitter < 0:
             raise ValueError("backoff/jitter values must be >= 0")
+        if self.max_elapsed_s < 0:
+            raise ValueError("max_elapsed_s must be >= 0 (0 = unbounded)")
 
 
 @dataclass(frozen=True)
@@ -309,6 +318,15 @@ class ChaosConfig:
     corrupt_mode: str = "truncate"  # truncate | flip
     nan_at_step: int = 0          # poison params+loss with NaN after step N
     sigterm_at_step: int = 0      # simulated preemption after step N
+    # --- serving faults (dtc_tpu/serve/, iteration numbers are 1-based
+    # scheduler iterations). Each exercises one serving recovery path on
+    # the production code: preemption drives evict->re-prefill, corruption
+    # drives the page-checksum verifier, the stall drives the serving
+    # hung-step watchdog, poisoned logits drive the finite-check + retry.
+    serve_preempt_at_step: int = 0       # evict the newest active request
+    serve_corrupt_page_at_step: int = 0  # damage a completed KV page of the oldest active request
+    serve_stall_at_step: int = 0         # sleep stall_s inside the scheduler loop
+    serve_poison_logits_at_step: int = 0  # the decode step's logits read back NaN
 
     def __post_init__(self) -> None:
         if self.corrupt_mode not in ("truncate", "flip"):
@@ -331,6 +349,107 @@ class ResilienceConfig:
     # lead process sha256-hashes the step. Turn off to restore pure async
     # saves when save cadence dominates (no integrity fallback then).
     verify_checkpoints: bool = True
+
+
+@dataclass(frozen=True)
+class ServeConfig:
+    """Serving-runtime configuration (``dtc_tpu/serve/``): continuous
+    batching over a paged KV cache with admission control, deadlines, and
+    chaos-verified recovery. See README "Serving runtime" and
+    ``configs/serve_config.yaml`` for knob semantics.
+    """
+
+    # In-flight decode batch width. This is the ONE compiled batch shape:
+    # requests are admitted into / evicted from these fixed slots at
+    # iteration boundaries without recompiling the decode step (enforced
+    # by the graph audit's serve_decode baseline: cold==1, steady==0).
+    slots: int = 4
+    # Tokens per KV page — the paged allocator's unit of accounting,
+    # integrity checksums, and chaos corruption.
+    page_size: int = 16
+    # Page-pool budget across all resident requests AND the shared-prefix
+    # store. 0 = auto (slots x ceil(max_seq_len / page_size): enough that
+    # the pool never binds; set it lower to model a cache smaller than the
+    # worst case and exercise eviction-and-re-prefill).
+    total_pages: int = 0
+    # Admission control: submit() beyond this depth raises a typed
+    # QueueFullError (backpressure — never a silent drop).
+    queue_depth: int = 64
+    max_new_tokens: int = 64     # per-request generation cap (requests may ask for less)
+    # Default per-request TTL measured from submit(); past it the request
+    # is cancelled (mid-decode included) with a typed DeadlineExceededError.
+    # 0 = no deadline. Requests may override per-request.
+    deadline_s: float = 0.0
+    # Prompts are right-padded to a multiple of this before prefill, so
+    # the number of distinct prefill compilations is bounded by
+    # max_seq_len / prefill_bucket instead of one per prompt length.
+    prefill_bucket: int = 32
+    # Graceful degradation: when queue occupancy crosses shed_watermark
+    # (fraction of queue_depth), excess requests are shed by policy with a
+    # typed ShedError; past degrade_watermark, NEW admissions have
+    # max_new_tokens capped at degrade_max_new_tokens (0 disables either
+    # behavior; shed_policy "priority" = lowest priority first, longest
+    # queued within a priority; "longest_queued" = pure FIFO-age).
+    shed_watermark: float = 0.75
+    shed_policy: str = "priority"
+    degrade_watermark: float = 0.0
+    degrade_max_new_tokens: int = 16
+    # Verify completed KV pages' integrity checksums every N scheduler
+    # iterations (0 = off). Detection cost is one reduction per resident
+    # page; a mismatch evicts the damaged request for bit-exact
+    # re-prefill. At 1, corruption is caught before any token computed
+    # from damaged cache is emitted (the chaos-parity guarantee).
+    verify_pages_every: int = 0
+    # Transient-fault retry for the serving step (poisoned logits,
+    # injected device faults) — same knob block as the data stream's.
+    retry: StreamRetryConfig = field(default_factory=lambda: StreamRetryConfig(
+        max_attempts=3, backoff_s=0.05, backoff_max_s=1.0, jitter=0.0,
+        max_elapsed_s=10.0,
+    ))
+    # Serving-mode hung-step watchdog (flagging layer of
+    # resilience/watchdog.py — a stalled scheduler iteration emits a
+    # hung_step event).
+    watchdog: WatchdogConfig = field(
+        default_factory=lambda: WatchdogConfig(enabled=True)
+    )
+    chaos: ChaosConfig = field(default_factory=ChaosConfig)
+
+    def __post_init__(self) -> None:
+        if self.slots < 1:
+            raise ValueError("slots must be >= 1")
+        if self.page_size < 1:
+            raise ValueError("page_size must be >= 1")
+        if self.total_pages < 0:
+            raise ValueError("total_pages must be >= 0 (0 = auto)")
+        if self.queue_depth < 1:
+            raise ValueError("queue_depth must be >= 1")
+        if self.max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        if self.prefill_bucket < 1:
+            raise ValueError("prefill_bucket must be >= 1")
+        if not 0.0 <= self.shed_watermark <= 1.0:
+            raise ValueError("shed_watermark must be in [0, 1]")
+        if not 0.0 <= self.degrade_watermark <= 1.0:
+            raise ValueError("degrade_watermark must be in [0, 1] (0 = off)")
+        if self.shed_policy not in ("priority", "longest_queued"):
+            raise ValueError(
+                f"unknown shed_policy {self.shed_policy!r}; expected "
+                "'priority' or 'longest_queued'"
+            )
+        if self.deadline_s < 0 or self.verify_pages_every < 0:
+            raise ValueError("deadline_s/verify_pages_every must be >= 0")
+        if (
+            self.chaos.enabled
+            and self.chaos.serve_corrupt_page_at_step > 0
+            and self.verify_pages_every <= 0
+        ):
+            raise ValueError(
+                "chaos.serve_corrupt_page_at_step requires "
+                "verify_pages_every >= 1: injected cache-block corruption "
+                "would otherwise never be detected and the damaged request "
+                "would complete with wrong tokens (use 1 for the bit-exact "
+                "no-tainted-tokens guarantee)"
+            )
 
 
 @dataclass(frozen=True)

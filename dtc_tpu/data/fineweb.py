@@ -73,6 +73,7 @@ def _wrap_resilient(
         backoff_s=retry.backoff_s,
         backoff_max_s=retry.backoff_max_s,
         jitter=retry.jitter,
+        max_elapsed_s=getattr(retry, "max_elapsed_s", 0.0),
         on_event=on_recovery,
         cancel=cancel,
     )

@@ -47,6 +47,26 @@ def init_cache(model, batch_size: int) -> PyTree:
     return jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), shapes["cache"])
 
 
+def decode_step(model, params: PyTree, cache: PyTree, tok: jax.Array):
+    """ONE decode iteration: apply the model to ``tok`` (B, T_new) with the
+    KV cache threaded through, returning ``(new_cache, logits)`` with
+    logits ``(B, T_new, V)``.
+
+    This is THE single-step function both decode drivers share: the greedy
+    scan below calls it with ``T_new == 1`` inside ``lax.scan``, and the
+    serving runtime's continuous-batching scheduler
+    (:mod:`dtc_tpu.serve.engine`) drives it directly — once per iteration
+    over its fixed slot batch (per-slot frontiers via a ``(B,)`` cache
+    index), and once per admission as the prefill over a padded prompt.
+    One definition means the serving path cannot drift numerically from
+    the generate path the parity tests pin."""
+    logits, mutated = model.apply(
+        {"params": params, "cache": cache}, tok,
+        train=False, decode=True, mutable=["cache"],
+    )
+    return mutated["cache"], logits
+
+
 def _top_k_mask(logits: jax.Array, k: int) -> jax.Array:
     """-inf everywhere below the k-th largest logit per row."""
     kth = jax.lax.top_k(logits, k)[0][..., -1:]
@@ -131,34 +151,25 @@ def _generate_impl(
     cache = init_cache(model, b)
 
     # Prefill: one forward over the whole prompt fills every layer's cache.
-    logits, mutated = model.apply(
-        {"params": params, "cache": cache}, prompt,
-        train=False, decode=True, mutable=["cache"],
-    )
+    cache, logits = decode_step(model, params, cache, prompt)
     rng, sub = jax.random.split(rng)
     first = sample(logits[:, -1], sub)
-
-    def step_logits(cache, tok):
-        return model.apply(
-            {"params": params, "cache": cache}, tok[:, None],
-            train=False, decode=True, mutable=["cache"],
-        )
 
     if greedy:
         def body(carry, _):
             cache, tok = carry
-            logits, mutated = step_logits(cache, tok)
+            cache, logits = decode_step(model, params, cache, tok[:, None])
             nxt = sample(logits[:, -1], None)
-            return (mutated["cache"], nxt), nxt
-        init = (mutated["cache"], first)
+            return (cache, nxt), nxt
+        init = (cache, first)
     else:
         def body(carry, _):
             cache, tok, key = carry
-            logits, mutated = step_logits(cache, tok)
+            cache, logits = decode_step(model, params, cache, tok[:, None])
             key, sub = jax.random.split(key)
             nxt = sample(logits[:, -1], sub)
-            return (mutated["cache"], nxt, key), nxt
-        init = (mutated["cache"], first, rng)
+            return (cache, nxt, key), nxt
+        init = (cache, first, rng)
 
     if max_new_tokens == 1:
         return first[:, None]

@@ -109,18 +109,35 @@ class CausalSelfAttention(nn.Module):
             # unsharded and the dynamic update partitions trivially);
             # decode then runs head-parallel up to out_proj's all-reduce,
             # same as training.
-            ck.value = nn.with_logical_constraint(
-                jax.lax.dynamic_update_slice(
-                    ck.value, k.reshape(b, t, hd), (0, idx, 0)
-                ),
-                ("batch", "seq", "heads"),
-            )
-            cv.value = nn.with_logical_constraint(
-                jax.lax.dynamic_update_slice(
-                    cv.value, v.reshape(b, t, hd), (0, idx, 0)
-                ),
-                ("batch", "seq", "heads"),
-            )
+            if idx.ndim == 1:
+                # Per-slot frontiers (the serving runtime's continuous
+                # batching: the cache index is (B,), one write position
+                # per slot). The batched dynamic_update_slice lowers to a
+                # scatter — each row writes at its own frontier.
+                write = jax.vmap(
+                    lambda c, u, i: jax.lax.dynamic_update_slice(c, u, (i, 0))
+                )
+                ck.value = nn.with_logical_constraint(
+                    write(ck.value, k.reshape(b, t, hd), idx),
+                    ("batch", "seq", "heads"),
+                )
+                cv.value = nn.with_logical_constraint(
+                    write(cv.value, v.reshape(b, t, hd), idx),
+                    ("batch", "seq", "heads"),
+                )
+            else:
+                ck.value = nn.with_logical_constraint(
+                    jax.lax.dynamic_update_slice(
+                        ck.value, k.reshape(b, t, hd), (0, idx, 0)
+                    ),
+                    ("batch", "seq", "heads"),
+                )
+                cv.value = nn.with_logical_constraint(
+                    jax.lax.dynamic_update_slice(
+                        cv.value, v.reshape(b, t, hd), (0, idx, 0)
+                    ),
+                    ("batch", "seq", "heads"),
+                )
             if (
                 cfg.decode_attention == "fused"
                 and t == 1
@@ -390,6 +407,11 @@ class GPTEmbed(nn.Module):
         wpe = nn.Embed(cfg.max_seq_len, cfg.d_model, name="wpe", param_dtype=pdtype)
         if isinstance(pos_offset, int) and pos_offset == 0:
             pos = wpe.embedding[:t][None, :, :]
+        elif getattr(pos_offset, "ndim", 0) == 1:
+            # Per-slot offsets (serving decode: each batch row at its own
+            # position) — a (B, t) gather instead of one shared slice.
+            rows = pos_offset[:, None] + jnp.arange(t, dtype=jnp.int32)[None]
+            pos = jnp.take(wpe.embedding, rows, axis=0)
         else:
             pos = jax.lax.dynamic_slice_in_dim(wpe.embedding, pos_offset, t, axis=0)[None]
         h = (tok + pos).astype(_dtype(cfg.compute_dtype))
@@ -530,6 +552,11 @@ class GPT(nn.Module):
         idx = None
         pos_offset: int | jax.Array = 0
         if decode:
+            # The index is () for generate's whole-batch decode, or (B,)
+            # when the caller built a per-slot cache (the serving
+            # runtime's continuous batching — dtc_tpu/serve/engine.py
+            # init_slot_cache): every decode consumer below branches on
+            # its STATIC rank, so both flavors share this one model.
             ci = self.variable("cache", "index", lambda: jnp.zeros((), jnp.int32))
             idx = ci.value
             if cfg.debug_checks:
@@ -539,11 +566,11 @@ class GPT(nn.Module):
                 from jax.experimental import checkify
 
                 checkify.check(
-                    idx + x.shape[1] <= cfg.max_seq_len,
+                    jnp.all(idx + x.shape[1] <= cfg.max_seq_len),
                     "decode cache overflow: write frontier {i} + {n} tokens "
                     "exceeds max_seq_len={m}; dynamic_update_slice would "
                     "clamp and corrupt the cache",
-                    i=idx, n=jnp.int32(x.shape[1]),
+                    i=jnp.max(idx), n=jnp.int32(x.shape[1]),
                     m=jnp.int32(cfg.max_seq_len),
                 )
             ci.value = idx + x.shape[1]

@@ -37,9 +37,32 @@ Event stream schema (JSONL, one shard per process — see README
 - ``recovery``     — a recovery action executed (``action``: stream_retry,
                      ckpt_fallback, rollback, tolerate, abort);
 - ``hung_step``    — watchdog flag: a step exceeded the configured multiple
-                     of the trailing median step time;
+                     of the trailing median step time (``runtime: serve``
+                     when the serving scheduler's watchdog flagged it);
 - ``run_summary``  — totals: tokens/s, MFU, peak HBM, compile/recompile
                      counts, est. comm bytes per step.
+
+Serving events (``dtc_tpu/serve/`` — SLO accounting rides the same
+registry: ``serve_queue_wait_s`` / ``serve_ttft_s`` /
+``serve_ms_per_token`` histograms plus shed/evict/expire/reject/retry
+counters land in the run summary):
+
+- ``serve_request``    — one terminal record per request: state, token
+                         count, typed error name, queue-wait/TTFT/
+                         ms-per-token, eviction/retry counts — the
+                         no-silent-drops contract (every submitted rid
+                         emits exactly one);
+- ``serve_admit``      — request entered a slot (slot, resident tokens,
+                         shared-prefix length);
+- ``serve_evict``      — eviction for recovery/pressure (``reason``:
+                         cache_pressure, admission_pressure, preempted,
+                         corruption) — the request re-queues and resumes
+                         bit-exactly via re-prefill;
+- ``serve_reject``     — typed admission rejection (queue_full /
+                         too_large), raised to the submitter;
+- ``serve_corruption`` — a completed KV page failed its integrity
+                         checksum (chaos or real) before eviction healed
+                         it.
 """
 
 from __future__ import annotations
@@ -123,6 +146,21 @@ class Telemetry:
             process_index=process_index,
             profiler=profiler,
             append=resumed,
+        )
+
+    @classmethod
+    def for_serving(
+        cls, output_dir: str, *, obs_cfg: Any = None, process_index: int = 0
+    ) -> "Telemetry":
+        """Telemetry for a :class:`dtc_tpu.serve.engine.ServingEngine`:
+        the engine emits its SLO instruments and ``serve_*`` events
+        through ``.registry``, landing in the same JSONL shard layout the
+        trainer uses (``<output_dir>/obs/events.r<k>.jsonl``) so the
+        multi-host reducer and existing tooling read serving runs
+        unchanged."""
+        return cls(
+            obs_cfg, output_dir=output_dir, lead=process_index == 0,
+            process_index=process_index,
         )
 
     def add_csv(self, path: str, fieldnames: tuple[str, ...], etype: str) -> CsvSink:

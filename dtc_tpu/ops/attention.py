@@ -52,7 +52,12 @@ def decode_attention(
     cache ``(B, S, H, D)`` (valid through ``start + T_new``). Causality:
     query row r (global position start + r) sees cache columns
     ``col <= start + r``; columns beyond the write frontier are masked the
-    same way. fp32 scores/softmax, same -1e9 semantics as training."""
+    same way. fp32 scores/softmax, same -1e9 semantics as training.
+
+    ``start`` is a scalar (every batch row at the same position — the
+    ``generate`` path) or a ``(B,)`` vector of per-row write frontiers
+    (the serving runtime's continuous-batching slots, each request at its
+    own position)."""
     b, t, h, d = q.shape
     s = k.shape[1]
     scale = d ** -0.5
@@ -61,8 +66,13 @@ def decode_attention(
     ) * scale
     row = jax.lax.broadcasted_iota(jnp.int32, (t, s), 0)
     col = jax.lax.broadcasted_iota(jnp.int32, (t, s), 1)
-    mask = col <= start + row
-    scores = jnp.where(mask[None, None], scores, NEG_INF)
+    if getattr(start, "ndim", 0) == 1:
+        # Per-row frontier: mask is (B, T, S), one frontier per batch row.
+        mask = col[None] <= start[:, None, None] + row[None]
+        scores = jnp.where(mask[:, None], scores, NEG_INF)
+    else:
+        mask = col <= start + row
+        scores = jnp.where(mask[None, None], scores, NEG_INF)
     weights = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
     out = jnp.einsum("bhts,bshd->bthd", weights.astype(v.dtype), v)
     return out.astype(q.dtype)

@@ -75,11 +75,13 @@ def supports(s: int) -> bool:
 
 
 def _decode_kernel_single(start_ref, q_ref, k_ref, v_ref, o_ref, *,
-                          s, g, d, scale):
+                          s, g, d, scale, per_row=False):
     """Whole-cache-in-one-tile decode step for the g heads of this lane
     block: per head, a (1, S) score row, masked to the frontier, one-pass
-    softmax, and a (1, D) output row. No scratch, no rescale passes."""
-    start = start_ref[0]
+    softmax, and a (1, D) output row. No scratch, no rescale passes.
+    ``per_row``: the SMEM frontier is (B,) — one write position per batch
+    row (the serving slots) — read at this program's batch index."""
+    start = start_ref[pl.program_id(0)] if per_row else start_ref[0]
     qt = q_ref[0]                                  # (1, g*d)
     kt, vt = k_ref[0], v_ref[0]                    # (s, g*d)
     col = jax.lax.broadcasted_iota(jnp.int32, (1, s), 1)
@@ -102,7 +104,8 @@ def _decode_kernel_single(start_ref, q_ref, k_ref, v_ref, o_ref, *,
 
 
 def _decode_kernel_blocked(start_ref, q_ref, k_ref, v_ref, o_ref,
-                           m_scr, l_scr, acc_scr, *, block_s, g, d, scale):
+                           m_scr, l_scr, acc_scr, *, block_s, g, d, scale,
+                           per_row=False):
     """Online-softmax decode step over KV blocks (caches past the
     single-tile bound). Blocks whose first column is beyond the write
     frontier are predicated out — a 32k-slot cache decoded at position
@@ -111,7 +114,7 @@ def _decode_kernel_blocked(start_ref, q_ref, k_ref, v_ref, o_ref,
     hold head gg's running stats in column gg (the packed-kernel
     convention); the output is written once at the last block."""
     j = pl.program_id(2)
-    start = start_ref[0]
+    start = start_ref[pl.program_id(0)] if per_row else start_ref[0]
 
     @pl.when(j == 0)
     def _():
@@ -165,8 +168,12 @@ def fused_decode_attention(
 
     ``q`` is ``(B, 1, H·D)`` — the one new token, model-native packed;
     ``k``/``v`` are the FULL cache ``(B, S, H·D)`` with valid columns
-    ``<= start`` (the scalar write frontier, the new token's position).
-    Returns ``(B, 1, H·D)`` in q's dtype. Numerics match
+    ``<= start`` (the write frontier, the new token's position). ``start``
+    is a scalar — one frontier for the whole batch, the ``generate`` path
+    — or a ``(B,)`` vector of per-row frontiers (the serving runtime's
+    continuous-batching slots; it rides in SMEM either way and each
+    (batch, group) program reads its own row's scalar). Returns
+    ``(B, 1, H·D)`` in q's dtype. Numerics match
     :func:`dtc_tpu.ops.attention.decode_attention` (fp32 softmax, -1e9
     mask) to fp roundoff; token-level decisions are exact in practice and
     asserted in tests/test_generate.py.
@@ -185,14 +192,18 @@ def fused_decode_attention(
     g, lb = _group(d, h)
     hg = hd // lb
     scale = float(d ** -0.5)
-    start = jnp.asarray(start, jnp.int32).reshape((1,))
+    start = jnp.asarray(start, jnp.int32)
+    per_row = start.ndim == 1 and start.shape[0] == b and b > 1
+    if not per_row:
+        start = start.reshape((1,))
 
     qspec = pl.BlockSpec((1, 1, lb), lambda bi, gi, *_: (bi, 0, gi))
     sspec = pl.BlockSpec(memory_space=pltpu.SMEM)
     if s <= _DECODE_MAX_SINGLE_S:
         return pl.pallas_call(
             functools.partial(
-                _decode_kernel_single, s=s, g=g, d=d, scale=scale
+                _decode_kernel_single, s=s, g=g, d=d, scale=scale,
+                per_row=per_row,
             ),
             grid=(b, hg),
             in_specs=[
@@ -214,7 +225,7 @@ def fused_decode_attention(
     return pl.pallas_call(
         functools.partial(
             _decode_kernel_blocked, block_s=_DECODE_BLOCK_S, g=g, d=d,
-            scale=scale,
+            scale=scale, per_row=per_row,
         ),
         grid=(b, hg, nkv),
         in_specs=[sspec, qspec, kvspec, kvspec],

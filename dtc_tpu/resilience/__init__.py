@@ -8,7 +8,8 @@ traffic) treats recovery as a first-class subsystem, not an afterthought:
 - :mod:`~dtc_tpu.resilience.guard` — loss-anomaly policy ladder
   (skip-update -> rollback to verified checkpoint -> clean abort);
 - :mod:`~dtc_tpu.resilience.retry` — position-preserving stream retry
-  (heals transient HF-streaming faults bit-exactly);
+  (heals transient HF-streaming faults bit-exactly) + the generic
+  elapsed-capped ``retry_call`` the serving runtime reuses;
 - :mod:`~dtc_tpu.resilience.watchdog` — hung-step flagging + hard timeout;
 - :mod:`~dtc_tpu.resilience.events` — thread-safe bus that feeds recovery
   actions into the telemetry stream;
@@ -28,7 +29,7 @@ from dtc_tpu.resilience.errors import (
 )
 from dtc_tpu.resilience.events import RecoveryBus
 from dtc_tpu.resilience.guard import AnomalyGuard, GuardDecision
-from dtc_tpu.resilience.retry import resilient_iterator
+from dtc_tpu.resilience.retry import resilient_iterator, retry_call
 from dtc_tpu.resilience.watchdog import StepWatchdog
 
 __all__ = [
@@ -43,4 +44,5 @@ __all__ = [
     "StepWatchdog",
     "WatchdogTimeout",
     "resilient_iterator",
+    "retry_call",
 ]

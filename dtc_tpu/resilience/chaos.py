@@ -13,6 +13,13 @@ The injector sits on the production code paths, never beside them: the data
 fault is raised underneath the same retry wrapper that heals real network
 errors, the checkpoint corruption hits real Orbax files on disk, and the
 simulated preemption goes through the process signal handler.
+
+The serving runtime (``dtc_tpu/serve/``) consults the ``serve_*`` hooks at
+its iteration boundaries: mid-request preemption and KV cache-block
+corruption drive the evict→re-prefill recovery path, the scheduler stall
+drives the serving hung-step watchdog, and poisoned logits drive the
+finite-check + retry-from-pre-step-cache path — all asserted
+token-for-token identical to an uninjected run in tests/test_serve.py.
 """
 
 from __future__ import annotations
@@ -95,6 +102,55 @@ class ChaosInjector:
         process, exercising the graceful-stop handler end to end."""
         return step == self.cfg.sigterm_at_step and self._fire(
             "sigterm", step=step
+        )
+
+    # ---- serving plane (dtc_tpu/serve/ — iteration numbers are 1-based
+    # scheduler iterations; the engine consults these at iteration
+    # boundaries so every fault lands on the production scheduler path) --
+    def serve_stall(self, it: int) -> float:
+        """Seconds the scheduler loop must stall at iteration ``it`` (0 =
+        no fault). The engine sleeps INSIDE its timed iteration, so the
+        serving hung-step watchdog sees a real outlier."""
+        if it == self.cfg.serve_stall_at_step and self._fire(
+            "serve_stall", iteration=it, stall_s=self.cfg.stall_s
+        ):
+            return self.cfg.stall_s
+        return 0.0
+
+    def serve_preempt(self, it: int) -> bool:
+        """Mid-request preemption: the engine evicts its newest active
+        request (pages freed, requeued) and must recover it bit-exactly
+        via re-prefill. Fires once at the FIRST iteration >= the
+        configured step where the engine consults it — the engine only
+        asks when it has an active request to preempt, so the shot is
+        never consumed (nor a chaos event emitted) with nothing to act
+        on."""
+        return (
+            0 < self.cfg.serve_preempt_at_step <= it
+            and self._fire("serve_preempt", iteration=it)
+        )
+
+    def serve_corrupt_page(self, it: int) -> bool:
+        """KV cache-block corruption: the engine damages a COMPLETED page
+        of its oldest active request on device, which the page-checksum
+        verifier must catch before the next token computed from it is
+        emitted. Same deferred-fire contract as :meth:`serve_preempt`
+        (consulted only when a completed page exists)."""
+        return (
+            0 < self.cfg.serve_corrupt_page_at_step <= it
+            and self._fire("serve_corrupt_page", iteration=it)
+        )
+
+    def serve_poison_logits(self, it: int) -> bool:
+        """Poisoned decode logits: the step's observed finite-check reads
+        false (as if the device returned NaN), driving the engine's
+        production retry path; the retry recomputes from the pre-step
+        cache and must land token-identical. Same deferred-fire contract
+        (the engine consults inside a decode attempt, so an in-flight
+        batch exists)."""
+        return (
+            0 < self.cfg.serve_poison_logits_at_step <= it
+            and self._fire("serve_poison_logits", iteration=it)
         )
 
     def maybe_corrupt_checkpoint(self, step: int, step_dir: str) -> bool:

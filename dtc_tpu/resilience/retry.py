@@ -43,11 +43,13 @@ def resilient_iterator(
     backoff_s: float = 1.0,
     backoff_max_s: float = 30.0,
     jitter: float = 0.1,
+    max_elapsed_s: float = 0.0,
     transient: tuple[type[BaseException], ...] = (Exception,),
     on_event: Callable[..., None] | None = None,
     sleep: Callable[[float], None] = time.sleep,
     rng: random.Random | None = None,
     cancel: Any = None,
+    clock: Callable[[], float] = time.monotonic,
 ) -> Iterator[Any]:
     """Yield ``factory(start_index)``'s items; self-heal on transient faults.
 
@@ -67,9 +69,19 @@ def resilient_iterator(
     wrapper ends the stream immediately instead of sleeping out up to
     ``backoff_max_s`` as an orphan that would re-open the source and post
     stale retry events.
+
+    ``max_elapsed_s`` (> 0) caps ONE fault episode in wall-clock terms: the
+    time since the episode's first failure, plus the delay a further retry
+    would add, may not exceed it. ``max_attempts`` alone lets a stalled
+    dependency hold the consumer for attempts x ``backoff_max_s`` — and
+    because the attempt counter resets on every successful yield, a source
+    that limps (one item per near-exhausted episode) can stall the
+    consumer unboundedly in aggregate while never exhausting attempts. The
+    elapsed cap turns "how long can a fault stall us" into one number.
     """
     index = start_index
     attempts = 0
+    episode_start: float | None = None
     it = None
     while True:
         try:
@@ -80,6 +92,9 @@ def resilient_iterator(
             return
         except transient as e:
             attempts += 1
+            now = clock()
+            if episode_start is None:
+                episode_start = now
             if attempts >= max_attempts:
                 raise DataStreamError(
                     f"data stream failed {attempts} consecutive attempts at "
@@ -88,6 +103,16 @@ def resilient_iterator(
             if cancel is not None and cancel.is_set():
                 return  # pipeline torn down: no event, no re-open
             delay = backoff_schedule(attempts, backoff_s, backoff_max_s, jitter, rng)
+            if (
+                max_elapsed_s > 0
+                and (now - episode_start) + delay > max_elapsed_s
+            ):
+                raise DataStreamError(
+                    f"data stream fault episode exceeded max_elapsed_s="
+                    f"{max_elapsed_s} at item {index} (attempt {attempts}, "
+                    f"{now - episode_start:.3f}s elapsed + {delay:.3f}s "
+                    f"backoff pending); giving up ({type(e).__name__}: {e})"
+                ) from e
             if on_event is not None:
                 on_event(
                     "recovery", action="stream_retry", index=index,
@@ -102,5 +127,55 @@ def resilient_iterator(
             it = None  # re-open at the exact failure position
             continue
         attempts = 0
+        episode_start = None
         index += 1
         yield item
+
+
+def retry_call(
+    fn: Callable[[], Any],
+    *,
+    max_attempts: int = 3,
+    backoff_s: float = 0.05,
+    backoff_max_s: float = 1.0,
+    jitter: float = 0.0,
+    max_elapsed_s: float = 0.0,
+    transient: tuple[type[BaseException], ...] = (Exception,),
+    on_event: Callable[..., None] | None = None,
+    sleep: Callable[[float], None] = time.sleep,
+    rng: random.Random | None = None,
+    clock: Callable[[], float] = time.monotonic,
+) -> Any:
+    """Call ``fn`` with the same backoff/attempt/elapsed discipline as
+    :func:`resilient_iterator`, for one-shot operations instead of streams
+    — the serving runtime's transient-fault wrapper (a decode step whose
+    logits read back non-finite, a prefill hit by an injected fault).
+
+    ``fn`` must be safe to re-invoke from scratch (the serving engine
+    re-runs its step from the pre-step cache, which JAX immutability keeps
+    alive for free). Returns ``fn()``'s value on the first success; after
+    ``max_attempts`` consecutive failures — or when the episode would
+    outlive ``max_elapsed_s`` (> 0) — re-raises the LAST underlying
+    exception unchanged, so callers keep their typed-error taxonomy.
+    ``on_event`` receives one ``("recovery", action="call_retry", ...)``
+    record per re-attempt (a :class:`RecoveryBus` post signature).
+    """
+    start = clock()
+    attempt = 0
+    while True:
+        try:
+            return fn()
+        except transient as e:
+            attempt += 1
+            delay = backoff_schedule(attempt, backoff_s, backoff_max_s, jitter, rng)
+            exhausted = attempt >= max_attempts or (
+                max_elapsed_s > 0 and (clock() - start) + delay > max_elapsed_s
+            )
+            if exhausted:
+                raise
+            if on_event is not None:
+                on_event(
+                    "recovery", action="call_retry", attempt=attempt,
+                    backoff_s=round(delay, 3), error=f"{type(e).__name__}: {e}",
+                )
+            sleep(delay)

@@ -1,0 +1,48 @@
+"""Resilient serving runtime (ROADMAP item 1 — the "millions of users"
+layer above the fused decode kernel of PR 4).
+
+- :mod:`~dtc_tpu.serve.engine` — continuous-batching scheduler + the one
+  compiled per-slot decode step (admission/eviction never recompile);
+- :mod:`~dtc_tpu.serve.paged_cache` — page-pool accounting over the
+  packed KV cache, prefix-store pins, integrity-checksum units;
+- :mod:`~dtc_tpu.serve.request` — request state machine, typed failure
+  taxonomy (rejection/shed/deadline/eviction are typed, never silent).
+
+Robustness is the load-bearing design input: overload sheds by policy,
+deadlines cancel mid-decode, cache exhaustion / preemption / detected
+corruption all take the same verified evict→re-prefill recovery path, and
+the chaos harness (``resilience.chaos`` serve hooks) proves each of them
+bit-exact in tier-1 CPU tests. See README "Serving runtime".
+"""
+
+from dtc_tpu.serve.engine import ServingEngine, init_slot_cache
+from dtc_tpu.serve.paged_cache import PageAllocator, pages_for
+from dtc_tpu.serve.request import (
+    DeadlineExceededError,
+    QueueFullError,
+    Request,
+    RequestFailedError,
+    RequestState,
+    RequestTooLargeError,
+    ServeError,
+    ServeResult,
+    ShedError,
+    TransientStepError,
+)
+
+__all__ = [
+    "DeadlineExceededError",
+    "PageAllocator",
+    "QueueFullError",
+    "Request",
+    "RequestFailedError",
+    "RequestState",
+    "RequestTooLargeError",
+    "ServeError",
+    "ServeResult",
+    "ServingEngine",
+    "ShedError",
+    "TransientStepError",
+    "init_slot_cache",
+    "pages_for",
+]

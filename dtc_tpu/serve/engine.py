@@ -1,0 +1,901 @@
+"""Continuous-batching serving engine over the paged KV cache.
+
+Resilience-first by construction: overload, stragglers, mid-request
+preemption, and cache exhaustion are the *steady state* of a loaded
+server, so every one of them is a first-class, chaos-testable path here —
+not an exception handler bolted on later.
+
+Shape of the runtime (Orca-style iteration-level scheduling over a
+vLLM-style paged budget, adapted to the model-native packed cache):
+
+- ONE compiled decode step over ``cfg.slots`` fixed batch slots (the
+  shared :func:`dtc_tpu.generate.decode_step`, driven with a per-slot
+  ``(B,)`` cache-index vector). Requests enter and leave slots at
+  iteration boundaries via a jitted cache-surgery ``insert`` whose slot
+  argument is *traced* — admission and eviction NEVER recompile the step
+  (audited: analysis baseline ``serve_decode``, cold==1 steady==0).
+- Admission = per-request prefill on a side (batch-1) cache, padded to
+  ``prefill_bucket`` so prefill compilations are bounded, then one
+  device-side copy into the slot row. A shared system prompt
+  (``Request.shared_prefix_len``) is prefilled once into the prefix store
+  and reused by every admission that matches it — the prefix-sharing win
+  is prefill compute (see paged_cache.py's honesty note on the dense
+  layout).
+- The paged allocator accounts every resident token in ``page_size``
+  blocks against one pool; exhaustion triggers *eviction-and-re-prefill*
+  (victim re-queues with its generated tokens and resumes bit-exactly —
+  greedy decode over prompt+generated reproduces the continuation), the
+  same recovery path mid-request preemption and detected cache-block
+  corruption take.
+- Robustness layer: bounded queue with typed rejection (QueueFullError),
+  shed-under-overload (lowest priority / longest queued past the
+  watermark, typed ShedError), per-request deadlines with mid-decode
+  cancellation (DeadlineExceededError), transient-fault retry from the
+  pre-step cache (``resilience.retry.retry_call`` + the logits finite
+  check), page-checksum verification on a cadence, and a serving-mode
+  hung-step watchdog. Chaos (``resilience.chaos`` serve hooks) injects
+  faults at iteration boundaries ON these production paths.
+- SLO accounting through ``obs``: queue-wait / TTFT / ms-per-token
+  histograms, shed/evict/expire/reject/retry counters, and one
+  ``serve_request`` event per terminal request — no silent drops.
+"""
+
+from __future__ import annotations
+
+import functools
+import time
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dtc_tpu.generate import decode_step, init_cache
+from dtc_tpu.obs.registry import MetricsRegistry
+from dtc_tpu.resilience.chaos import ChaosInjector
+from dtc_tpu.resilience.events import RecoveryBus
+from dtc_tpu.resilience.retry import retry_call
+from dtc_tpu.resilience.watchdog import StepWatchdog
+from dtc_tpu.serve.paged_cache import PageAllocator, pages_for
+from dtc_tpu.serve.request import (
+    TERMINAL_STATES,
+    DeadlineExceededError,
+    QueueFullError,
+    Request,
+    RequestFailedError,
+    RequestState,
+    RequestTooLargeError,
+    ServeResult,
+    ShedError,
+    TransientStepError,
+)
+
+PyTree = Any
+
+
+def init_slot_cache(model, slots: int) -> PyTree:
+    """Decode cache for ``slots`` independent slots: the standard cache
+    with the scalar write frontier replaced by a ``(slots,)`` per-slot
+    vector — the model branches on the index's static rank, so this one
+    swap turns whole-batch decode into continuous-batching decode."""
+    cache = dict(init_cache(model, slots))
+    cache["index"] = jnp.zeros((slots,), jnp.int32)
+    return cache
+
+
+def _pad_to_bucket(tokens: list[int], bucket: int, limit: int) -> list[int]:
+    """Right-pad to the next bucket multiple, clamped to ``limit`` (the
+    remaining cache room — padding past it would make the prefill's
+    dynamic_update_slice clamp its start and smear pad garbage over valid
+    positions)."""
+    n = len(tokens)
+    padded = min(((n + bucket - 1) // bucket) * bucket, limit)
+    return tokens + [0] * (padded - n)
+
+
+class _Slot:
+    """Host-side per-slot record: who occupies it, the write frontier
+    (tokens RESIDENT in the cache row), and fingerprints of completed
+    pages for the integrity verifier."""
+
+    __slots__ = ("rid", "frontier", "page_fp")
+
+    def __init__(self) -> None:
+        self.rid: str | None = None
+        self.frontier = 0
+        self.page_fp: dict[int, float] = {}
+
+
+class ServingEngine:
+    """See module docstring. Construct once per (model, params, config);
+    ``submit()`` requests, then drive ``step()`` (or ``run()``) —
+    iteration boundaries are where admission, eviction, deadlines,
+    shedding, verification, and chaos all land."""
+
+    def __init__(
+        self,
+        model,
+        params: PyTree,
+        cfg,
+        *,
+        telemetry=None,
+        clock: Callable[[], float] = time.monotonic,
+        sleep: Callable[[float], None] = time.sleep,
+    ):
+        self.model = model
+        self.params = params
+        self.cfg = cfg
+        self.mcfg = model.cfg
+        if getattr(self.mcfg, "debug_checks", False):
+            # The model would emit checkify.check guards that must be
+            # functionalized before jit (see generate.py's debug path);
+            # the engine jits decode_step directly, and the per-slot
+            # overflow guard is the engine's own page/frontier accounting
+            # here — fail clearly instead of erroring mid-trace.
+            raise ValueError(
+                "ServingEngine does not support model debug_checks=True "
+                "(unfunctionalized checkify under jit); serve a config "
+                "with debug_checks=False and use generate() for dev-mode "
+                "assertions"
+            )
+        self.clock = clock
+        self.sleep = sleep
+        self.telemetry = telemetry
+        self.reg: MetricsRegistry = (
+            telemetry.registry if telemetry is not None else MetricsRegistry()
+        )
+        self.bus = RecoveryBus()
+        self.chaos = (
+            ChaosInjector(cfg.chaos, self.bus) if cfg.chaos.enabled else None
+        )
+        self.watchdog = (
+            StepWatchdog(cfg.watchdog) if cfg.watchdog.enabled else None
+        )
+        # Page checksums cost a device reduction + blocking transfer per
+        # collection; only pay it when someone will read them (the
+        # verifier cadence, or injected page corruption the verifier must
+        # catch — other chaos kinds never touch the checksums).
+        self._track_pages = cfg.verify_pages_every > 0 or (
+            cfg.chaos.enabled and cfg.chaos.serve_corrupt_page_at_step > 0
+        )
+
+        pool = cfg.total_pages or cfg.slots * pages_for(
+            self.mcfg.max_seq_len, cfg.page_size
+        )
+        self.alloc = PageAllocator(pool, cfg.page_size)
+
+        self.cache = init_slot_cache(model, cfg.slots)
+        self.slots = [_Slot() for _ in range(cfg.slots)]
+        self.last_tok = np.zeros((cfg.slots,), np.int32)
+
+        self.queue: list[Request] = []
+        self.requests: dict[str, Request] = {}
+        self.results: dict[str, ServeResult] = {}
+        self._eff_max_new: dict[str, int] = {}
+        self._deadline: dict[str, float] = {}
+        self._prefix_store: dict[tuple, tuple[PyTree, int]] = {}
+        self._retry_scope: list[str] = []  # rids charged for in-flight retries
+        self._it = 0
+        self._worked = False  # did this iteration run the model
+        self._fps_memo: Any = None  # checksum table for the CURRENT cache
+
+        self._build_fns()
+
+    # ------------------------------------------------------------------
+    # jitted device functions (each compiles ONCE; every per-request
+    # quantity — slot, frontier, valid length — is a traced argument)
+    # ------------------------------------------------------------------
+    def _build_fns(self) -> None:
+        model = self.model
+
+        @jax.jit
+        def step_fn(params, cache, toks):
+            """One continuous-batching decode iteration over ALL slots
+            (idle slots compute garbage that is masked/overwritten before
+            any read — fixed shapes are what keep this recompile-free).
+            Greedy argmax matches generate()'s greedy fast path exactly;
+            the per-slot finite flag is the poisoned-logits detector."""
+            cache, logits = decode_step(model, params, cache, toks[:, None])
+            last = logits[:, -1]
+            nxt = jnp.argmax(last, axis=-1).astype(jnp.int32)
+            finite = jnp.all(jnp.isfinite(last.astype(jnp.float32)), axis=-1)
+            return cache, nxt, finite
+
+        @jax.jit
+        def prefill_fn(params, cache, prompt, n_valid):
+            """Batch-1 prefill over a bucket-padded prompt chunk starting
+            at the cache's current scalar frontier. Samples the next token
+            from the last VALID row (pad rows' outputs are discarded; pad
+            K/V lands beyond the frontier the insert below pins, so it is
+            masked until real decode overwrites it)."""
+            cache, logits = decode_step(model, params, cache, prompt)
+            row = logits[0, n_valid - 1]
+            tok = jnp.argmax(row, axis=-1).astype(jnp.int32)
+            finite = jnp.all(jnp.isfinite(row.astype(jnp.float32)))
+            return cache, tok, finite
+
+        @jax.jit
+        def insert_fn(batch_cache, row_cache, slot, n_tokens):
+            """Admission surgery: copy a prefilled batch-1 cache into slot
+            row ``slot`` and pin that slot's frontier to ``n_tokens`` (the
+            VALID length — not the padded length the prefill advanced its
+            scalar index by). ``slot`` is traced: admitting into any slot
+            reuses this one executable."""
+            n = jnp.asarray(n_tokens, jnp.int32)
+
+            def leaf(b, r):
+                if b.ndim == 1:  # the (slots,) frontier vector
+                    return jax.lax.dynamic_update_slice(b, n[None], (slot,))
+                start = (0, slot) + (0,) * (b.ndim - 2)
+                return jax.lax.dynamic_update_slice(b, r, start)
+
+            return jax.tree.map(leaf, batch_cache, row_cache)
+
+        psize = self.cfg.page_size
+
+        @jax.jit
+        def fingerprint_fn(cache):
+            """Integrity checksums of EVERY completed-page candidate in
+            one launch: a (slots, n_pages) fp32 table, one device call
+            and ONE transfer per use — never a host round-trip per page
+            (the hot-loop host-sync pattern analysis/hostsync.py lints
+            against in the trainer). Position-weighted SIGNED sums, not
+            sum(|x|): a plain magnitude sum is blind to sign-bit flips
+            and to value permutations within a page — realistic memory
+            faults the verifier exists to catch. Deterministic for
+            identical bytes (fixed weights, fixed reduction order), so
+            the verifier recomputes bit-equal unless the page changed."""
+            total = None
+            for leaf in jax.tree.leaves(cache):
+                if leaf.ndim < 4:
+                    continue
+                l, b_, s_, hd_ = leaf.shape
+                n_pages = s_ // psize
+                blk = leaf[:, :, : n_pages * psize, :].reshape(
+                    l, b_, n_pages, psize, hd_
+                ).astype(jnp.float32)
+                w_l = 1.0 + 0.127 * jnp.arange(l, dtype=jnp.float32)
+                w_p = 1.0 + 0.3183 * jnp.arange(psize, dtype=jnp.float32)
+                w_f = 1.0 + 0.0721 * jnp.arange(hd_, dtype=jnp.float32)
+                w = (
+                    w_l[:, None, None, None, None]
+                    * w_p[None, None, None, :, None]
+                    * w_f[None, None, None, None, :]
+                )
+                fp = jnp.sum(blk * w, axis=(0, 3, 4))
+                total = fp if total is None else total + fp
+            return total
+
+        @functools.partial(jax.jit, static_argnames=("size",))
+        def corrupt_fn(cache, slot, start, size):
+            """Chaos-only: overwrite one page of the first KV leaf with a
+            constant — finite (so the logits check cannot catch it; only
+            the checksum verifier can), device-side, on the real cache."""
+            leaves, treedef = jax.tree.flatten(cache)
+            done = False
+            out = []
+            for leaf in leaves:
+                if not done and leaf.ndim >= 4:
+                    blk = jnp.full(
+                        (leaf.shape[0], 1, size, leaf.shape[3]), 123.25,
+                        leaf.dtype,
+                    )
+                    leaf = jax.lax.dynamic_update_slice(
+                        leaf, blk, (0, slot, start, 0)
+                    )
+                    done = True
+                out.append(leaf)
+            return jax.tree.unflatten(treedef, out)
+
+        self._step_fn = step_fn
+        self._prefill_fn = prefill_fn
+        self._insert_fn = insert_fn
+        self._fingerprint_fn = fingerprint_fn
+        self._corrupt_fn = corrupt_fn
+
+    # ------------------------------------------------------------------
+    # submission (admission control)
+    # ------------------------------------------------------------------
+    def submit(self, req: Request) -> str:
+        """Enqueue one request. Typed backpressure — raises
+        :class:`QueueFullError` past ``queue_depth`` and
+        :class:`RequestTooLargeError` for requests that could never run;
+        neither is ever dropped silently. A ``rid`` may only be reused
+        after its previous submission reached a terminal state (the new
+        result then replaces the old one) — resubmitting an in-flight rid
+        is a caller bug that would silently merge two requests into one
+        record, so it raises ``ValueError`` like the Request validators."""
+        if req.rid in self.requests:  # present == not yet terminal
+            raise ValueError(
+                f"request {req.rid}: rid already in flight "
+                f"(state {self.results[req.rid].state.value})"
+            )
+        now = self.clock()
+        total = len(req.prompt) + req.max_new_tokens
+        if total > self.mcfg.max_seq_len:
+            self.reg.counter("serve_rejected").inc()
+            self.reg.emit("serve_reject", rid=req.rid, reason="too_large")
+            raise RequestTooLargeError(
+                f"request {req.rid}: prompt ({len(req.prompt)}) + "
+                f"max_new_tokens ({req.max_new_tokens}) exceeds max_seq_len "
+                f"({self.mcfg.max_seq_len})"
+            )
+        if pages_for(total, self.cfg.page_size) > self.alloc.total_pages:
+            self.reg.counter("serve_rejected").inc()
+            self.reg.emit("serve_reject", rid=req.rid, reason="too_large")
+            raise RequestTooLargeError(
+                f"request {req.rid}: footprint "
+                f"{pages_for(total, self.cfg.page_size)} pages exceeds the "
+                f"pool ({self.alloc.total_pages})"
+            )
+        if len(self.queue) >= self.cfg.queue_depth:
+            self.reg.counter("serve_rejected").inc()
+            self.reg.emit("serve_reject", rid=req.rid, reason="queue_full")
+            raise QueueFullError(
+                f"request {req.rid}: queue at depth {self.cfg.queue_depth}"
+            )
+        self.requests[req.rid] = req
+        self.results[req.rid] = ServeResult(
+            rid=req.rid, state=RequestState.QUEUED, tokens=[], submitted_t=now
+        )
+        ttl = self.cfg.deadline_s if req.deadline_s is None else req.deadline_s
+        self._deadline[req.rid] = now + ttl if ttl and ttl > 0 else float("inf")
+        self.queue.append(req)
+        self.reg.counter("serve_submitted").inc()
+        return req.rid
+
+    def drain_results(self) -> dict[str, ServeResult]:
+        """Remove and return every TERMINAL result — the long-running
+        caller's memory-reclamation API (``results`` otherwise holds
+        each terminal record, tokens included, until drained)."""
+        done = {
+            rid: r for rid, r in self.results.items()
+            if r.state in TERMINAL_STATES
+        }
+        for rid in done:
+            del self.results[rid]
+        return done
+
+    # ------------------------------------------------------------------
+    # the scheduler iteration
+    # ------------------------------------------------------------------
+    def step(self) -> bool:
+        """One iteration: faults/expiry/shed/admit at the boundary, then
+        one decode step over the in-flight batch. Returns True while any
+        request is queued or in flight."""
+        self._it += 1
+        self._worked = False  # set by _do_admit/_decode (model ran)
+        t0 = self.clock()
+        if self.chaos is not None:
+            stall = self.chaos.serve_stall(self._it)
+            if stall > 0:
+                self.sleep(stall)  # inside the timed iteration, on purpose
+        self._expire()
+        self._shed()
+        self._admit()
+        # Condition-dependent chaos shots are consulted ONLY when the
+        # engine can act (a completed page / an active request exists) —
+        # otherwise the fire-once shot would be consumed, and a chaos
+        # event emitted, for an injection that never physically happened.
+        if (
+            self.chaos is not None
+            and self._corruption_candidates()
+            and self.chaos.serve_corrupt_page(self._it)
+        ):
+            self._inject_corruption()
+        if (
+            self.cfg.verify_pages_every > 0
+            and self._it % self.cfg.verify_pages_every == 0
+        ):
+            self._verify_pages()
+        if (
+            self.chaos is not None
+            and any(s.rid is not None for s in self.slots)
+            and self.chaos.serve_preempt(self._it)
+        ):
+            self._preempt_newest()
+        self._ensure_pages()
+        self._decode()
+        # Only WORKING iterations (a prefill or decode ran) feed the
+        # watchdog: idle polling spins are microsecond-scale, and letting
+        # them into the trailing median would flag every healthy decode
+        # iteration of an interleaved submit()/step() caller as hung.
+        if self.watchdog is not None and self._worked:
+            flag = self.watchdog.observe(self._it, self.clock() - t0)
+            if flag is not None:
+                self.reg.counter("serve_hung_steps").inc()
+                self.reg.emit("hung_step", runtime="serve", **flag)
+        self._drain_bus()
+        return bool(self.queue) or any(s.rid is not None for s in self.slots)
+
+    def run(self, *, max_steps: int = 100_000) -> dict[str, ServeResult]:
+        """Drive ``step()`` until idle (every submitted request terminal)
+        or ``max_steps`` iterations THIS CALL (a per-call budget, not the
+        engine-lifetime counter — interleaved ``submit()``/``run()``
+        callers get the full budget every time). Batch-mode entry point;
+        interactive callers interleave ``submit()`` with their own
+        ``step()`` loop."""
+        for _ in range(max_steps):
+            if not self.step():
+                break
+        return self.results
+
+    # ------------------------------------------------------------------
+    # boundary phases
+    # ------------------------------------------------------------------
+    def _expire(self) -> None:
+        now = self.clock()
+        for req in list(self.queue):
+            if now > self._deadline[req.rid]:
+                self.queue.remove(req)
+                self._finish(
+                    req.rid, RequestState.EXPIRED,
+                    DeadlineExceededError(
+                        f"request {req.rid} expired after "
+                        f"{now - self.results[req.rid].submitted_t:.3f}s in queue"
+                    ),
+                )
+        for slot in self.slots:
+            if slot.rid is not None and now > self._deadline[slot.rid]:
+                rid = slot.rid
+                self._release_slot(rid)
+                self._finish(
+                    rid, RequestState.EXPIRED,
+                    DeadlineExceededError(
+                        f"request {rid} expired mid-decode (cancelled)"
+                    ),
+                )
+
+    def _shed(self) -> None:
+        wm = self.cfg.shed_watermark
+        if wm <= 0 or not self.queue:
+            return
+        target = int(wm * self.cfg.queue_depth)
+        while len(self.queue) > target:
+            if self.cfg.shed_policy == "longest_queued":
+                victim = min(
+                    self.queue, key=lambda r: self.results[r.rid].submitted_t
+                )
+            else:  # priority: lowest first, longest-queued within
+                victim = min(
+                    self.queue,
+                    key=lambda r: (r.priority, self.results[r.rid].submitted_t),
+                )
+            self.queue.remove(victim)
+            self._finish(
+                victim.rid, RequestState.SHED,
+                ShedError(
+                    f"request {victim.rid} shed under overload (queue "
+                    f"{len(self.queue) + 1} > watermark {target} of "
+                    f"{self.cfg.queue_depth})"
+                ),
+            )
+
+    def _admit(self) -> None:
+        while self.queue:
+            free = [i for i, s in enumerate(self.slots) if s.rid is None]
+            if not free:
+                return
+            # Highest priority first, FIFO within a priority.
+            cand = max(
+                self.queue,
+                key=lambda r: (r.priority, -self.results[r.rid].submitted_t),
+            )
+            seq = list(cand.prompt) + self.results[cand.rid].tokens
+            need = pages_for(len(seq) + 1, self.cfg.page_size)
+            if not self._make_room(need, cand.priority):
+                return  # pool-bound: wait (deadlines/shedding keep it honest)
+            # Reserve BEFORE the prefix store can pin pages out from under
+            # this admission — the store competes for whatever remains.
+            self.alloc.alloc(cand.rid, need)
+            self.queue.remove(cand)
+            self._do_admit(cand, free[0], seq)
+
+    def _make_room(self, need: int, priority: int) -> bool:
+        """Free pages for an admission: drop LRU prefix-store entries
+        first, then evict strictly-lower-priority active requests (never
+        equals — admission must not thrash same-priority work)."""
+        while not self.alloc.can_fit(need):
+            key = self.alloc.evict_prefix_lru()
+            if key is None:
+                break
+            self._prefix_store.pop(key, None)
+            self.reg.counter("serve_prefix_evictions").inc()
+        while not self.alloc.can_fit(need):
+            victims = [
+                s.rid for s in self.slots
+                if s.rid is not None and self.requests[s.rid].priority < priority
+            ]
+            if not victims:
+                return False
+            victim = min(
+                victims,
+                key=lambda r: (
+                    self.requests[r].priority,
+                    -(self.results[r].admitted_t or 0.0),
+                ),
+            )
+            self._evict(victim, reason="admission_pressure")
+        return True
+
+    def _prefix_base(self, req: Request) -> tuple[PyTree, int]:
+        """(base cache, base length) for this request's prefill: the
+        shared-prefix store entry when one matches (prefilled once,
+        reused by every admission), else a fresh batch-1 cache."""
+        plen = min(req.shared_prefix_len, len(req.prompt) - 1)
+        if plen <= 0:
+            return init_cache(self.model, 1), 0
+        key = tuple(int(t) for t in req.prompt[:plen])
+        if key in self._prefix_store:
+            self.alloc.touch_prefix(key)
+            self.reg.counter("serve_prefix_hits").inc()
+            return self._prefix_store[key]
+        n_pages = pages_for(plen, self.cfg.page_size)
+        fits = self.alloc.pin_prefix(key, n_pages)
+        while not fits:
+            lru = self.alloc.evict_prefix_lru()
+            if lru is None:
+                break
+            self._prefix_store.pop(lru, None)
+            fits = self.alloc.pin_prefix(key, n_pages)
+        if not fits:
+            return init_cache(self.model, 1), 0  # no room: skip sharing
+        padded = _pad_to_bucket(
+            list(key), self.cfg.prefill_bucket, self.mcfg.max_seq_len
+        )
+        try:
+            cache, _tok, _fin = self._checked_prefill(
+                init_cache(self.model, 1), padded, plen
+            )
+        except TransientStepError:
+            # The entry was never stored: un-account its pinned pages or
+            # they leak from the pool with no store key to evict.
+            self.alloc.drop_prefix(key)
+            raise
+        # Pin the stored frontier to the VALID prefix length — the prefill
+        # advanced it by the padded length, and a suffix prefill resuming
+        # from the padded position would shift every later position (the
+        # pad garbage beyond plen is overwritten/masked, but the index
+        # must not count it).
+        cache = dict(cache)
+        cache["index"] = jnp.asarray(plen, jnp.int32)
+        self._prefix_store[key] = (cache, plen)
+        self.reg.counter("serve_prefix_builds").inc()
+        return self._prefix_store[key]
+
+    def _checked_prefill(self, base: PyTree, padded: list[int], n_valid: int):
+        """Prefill + finite check under the transient-fault retry (the
+        production path poisoned logits and injected device faults take)."""
+        prompt = jnp.asarray(np.asarray(padded, np.int32)[None])
+
+        def attempt():
+            cache, tok, fin = self._prefill_fn(
+                self.params, base, prompt, jnp.int32(n_valid)
+            )
+            if not bool(np.asarray(fin)):
+                raise TransientStepError("prefill produced non-finite logits")
+            self.reg.counter("serve_prefills").inc()
+            return cache, tok, fin
+
+        r = self.cfg.retry
+        try:
+            return retry_call(
+                attempt, transient=(TransientStepError,),
+                max_attempts=r.max_attempts, backoff_s=r.backoff_s,
+                backoff_max_s=r.backoff_max_s, jitter=r.jitter,
+                max_elapsed_s=r.max_elapsed_s, on_event=self._on_retry_event,
+                sleep=self.sleep, clock=self.clock,
+            )
+        finally:
+            self._retry_scope = []
+
+    def _do_admit(self, req: Request, slot_i: int, seq: list[int]) -> None:
+        self._worked = True  # a prefill runs whatever the outcome
+        res = self.results[req.rid]
+        res.state = RequestState.PREFILL
+        if req.rid not in self._eff_max_new:
+            eff = req.max_new_tokens
+            if (
+                self.cfg.degrade_watermark > 0
+                and self.cfg.degrade_max_new_tokens > 0
+                and (len(self.queue) + 1) / self.cfg.queue_depth
+                > self.cfg.degrade_watermark
+            ):
+                eff = min(eff, self.cfg.degrade_max_new_tokens)
+                if eff < req.max_new_tokens:
+                    res.degraded = True
+                    self.reg.counter("serve_degraded").inc()
+            self._eff_max_new[req.rid] = eff
+
+        try:
+            # The prefix-store build is INSIDE the guarded region: a
+            # retry-exhausted prefix prefill must end this request typed
+            # (FAILED) with its pages returned, not escape the scheduler.
+            self._retry_scope = [req.rid]
+            base, base_len = self._prefix_base(req)
+            suffix = seq[base_len:]
+            padded = _pad_to_bucket(
+                suffix, self.cfg.prefill_bucket, self.mcfg.max_seq_len - base_len
+            )
+            self._retry_scope = [req.rid]
+            cache1, tok, _fin = self._checked_prefill(base, padded, len(suffix))
+        except TransientStepError as e:
+            self._release_slot(req.rid)  # return the reserved pages
+            err = RequestFailedError(
+                f"request {req.rid}: prefill retries exhausted"
+            )
+            err.__cause__ = e
+            self._finish(req.rid, RequestState.FAILED, err)
+            return
+        self.cache = self._insert_fn(
+            self.cache, cache1, jnp.int32(slot_i), jnp.int32(len(seq))
+        )
+        self._fps_memo = None
+        slot = self.slots[slot_i]
+        slot.rid = req.rid
+        slot.frontier = len(seq)
+        slot.page_fp = {}
+        if self._track_pages and len(seq) >= self.cfg.page_size:
+            fps = self._page_fps()
+            for p in range(len(seq) // self.cfg.page_size):
+                slot.page_fp[p] = float(fps[slot_i, p])
+        now = self.clock()
+        res.admitted_t = now
+        res.state = RequestState.DECODE
+        tok = int(np.asarray(tok))
+        res.tokens.append(tok)
+        if res.first_token_t is None:
+            res.first_token_t = now
+            self.reg.histogram("serve_ttft_s").observe(res.ttft_s or 0.0)
+            self.reg.histogram("serve_queue_wait_s").observe(
+                res.queue_wait_s or 0.0
+            )
+        self.last_tok[slot_i] = tok
+        self.reg.counter("serve_admissions").inc()
+        self.reg.emit(
+            "serve_admit", rid=req.rid, slot=slot_i, resident=len(seq),
+            prefix_len=base_len, iteration=self._it,
+        )
+        self._maybe_complete(slot_i)
+
+    def _ensure_pages(self) -> None:
+        """Before decoding, every active slot needs pages covering its
+        NEXT write (frontier + 1). Exhaustion evicts the lowest-priority,
+        most-recently-admitted request — possibly the grower itself."""
+        for i, slot in enumerate(self.slots):
+            if slot.rid is None:
+                continue
+            need = pages_for(slot.frontier + 1, self.cfg.page_size)
+            while not self.alloc.ensure(slot.rid, need):
+                key = self.alloc.evict_prefix_lru()
+                if key is not None:
+                    self._prefix_store.pop(key, None)
+                    self.reg.counter("serve_prefix_evictions").inc()
+                    continue
+                active = [s.rid for s in self.slots if s.rid is not None]
+                victim = min(
+                    active,
+                    key=lambda r: (
+                        self.requests[r].priority,
+                        -(self.results[r].admitted_t or 0.0),
+                    ),
+                )
+                self._evict(victim, reason="cache_pressure")
+                if victim == slot.rid:
+                    break
+
+    def _decode(self) -> None:
+        active = [
+            (i, s.rid) for i, s in enumerate(self.slots) if s.rid is not None
+        ]
+        if not active:
+            return
+        self._worked = True
+        prev_cache = self.cache  # kept alive so a retry re-runs bit-exactly
+        toks = jnp.asarray(self.last_tok)
+        last_fin = np.ones((self.cfg.slots,), bool)
+
+        def attempt():
+            nonlocal last_fin
+            cache, nxt, fin = self._step_fn(self.params, prev_cache, toks)
+            nxt = np.asarray(nxt)
+            fin = np.asarray(fin).copy()
+            if self.chaos is not None and self.chaos.serve_poison_logits(
+                self._it
+            ):
+                fin[:] = False  # the observed device buffer reads back NaN
+            last_fin = fin
+            if not all(bool(fin[i]) for i, _ in active):
+                raise TransientStepError(
+                    f"non-finite logits in decode step (iteration {self._it})"
+                )
+            return cache, nxt
+
+        r = self.cfg.retry
+        self._retry_scope = [rid for _, rid in active]
+        try:
+            cache, nxt = retry_call(
+                attempt, transient=(TransientStepError,),
+                max_attempts=r.max_attempts, backoff_s=r.backoff_s,
+                backoff_max_s=r.backoff_max_s, jitter=r.jitter,
+                max_elapsed_s=r.max_elapsed_s, on_event=self._on_retry_event,
+                sleep=self.sleep, clock=self.clock,
+            )
+        except TransientStepError as e:
+            # Localize the blast radius: only slots whose logits actually
+            # read non-finite on the LAST attempt fail; co-scheduled
+            # healthy requests keep their slots and retry next iteration
+            # (the step's outputs were discarded, so nothing advanced —
+            # their pre-step cache is intact).
+            for i, rid in active:
+                if bool(last_fin[i]):
+                    continue
+                self._release_slot(rid)
+                err = RequestFailedError(
+                    f"request {rid}: decode step retries exhausted"
+                )
+                err.__cause__ = e
+                self._finish(rid, RequestState.FAILED, err)
+            return
+        finally:
+            self._retry_scope = []
+        self.cache = cache
+        self._fps_memo = None
+        now = self.clock()
+        completed_pages = []  # (slot_i, page) finished this step
+        for i, rid in active:
+            slot = self.slots[i]
+            res = self.results[rid]
+            tok = int(nxt[i])
+            res.tokens.append(tok)
+            self.last_tok[i] = tok
+            slot.frontier += 1  # the step's input token is now resident
+            if self._track_pages and slot.frontier % self.cfg.page_size == 0:
+                completed_pages.append((i, slot.frontier // self.cfg.page_size - 1))
+        if completed_pages:
+            fps = self._page_fps()
+            for i, p in completed_pages:
+                self.slots[i].page_fp[p] = float(fps[i, p])
+        for i, _rid in active:
+            self._maybe_complete(i, now=now)
+        self.reg.counter("serve_decode_steps").inc()
+        self.reg.histogram("serve_batch_occupancy").observe(len(active))
+
+    # ------------------------------------------------------------------
+    # recovery paths
+    # ------------------------------------------------------------------
+    def _evict(self, rid: str, *, reason: str) -> None:
+        """Evict one active request: free pages + slot, requeue at the
+        head with its generated tokens intact. Re-admission re-prefills
+        prompt+generated and resumes — greedy decode makes the
+        continuation token-for-token identical (asserted in tests)."""
+        self._release_slot(rid)
+        res = self.results[rid]
+        res.state = RequestState.EVICTED  # observable until re-admission
+        res.n_evictions += 1
+        self.queue.insert(0, self.requests[rid])
+        self.reg.counter("serve_evictions").inc()
+        self.reg.emit(
+            "serve_evict", rid=rid, reason=reason, iteration=self._it,
+            generated=len(res.tokens),
+        )
+
+    def _preempt_newest(self) -> None:
+        active = [s.rid for s in self.slots if s.rid is not None]
+        if not active:
+            return
+        victim = max(active, key=lambda r: self.results[r].admitted_t or 0.0)
+        self.reg.counter("serve_preemptions").inc()
+        self._evict(victim, reason="preempted")
+
+    def _corruption_candidates(self) -> list:
+        """Slots with a completed (fingerprinted) page — what chaos
+        corruption and the verifier can act on."""
+        return [
+            (i, s) for i, s in enumerate(self.slots)
+            if s.rid is not None and s.page_fp
+        ]
+
+    def _inject_corruption(self) -> None:
+        """Chaos: damage a completed page of the oldest active request on
+        the real device cache (the verifier must catch it)."""
+        cands = self._corruption_candidates()
+        if not cands:
+            return
+        i, slot = min(
+            cands, key=lambda t: self.results[t[1].rid].admitted_t or 0.0
+        )
+        page = min(slot.page_fp)
+        self.cache = self._corrupt_fn(
+            self.cache, jnp.int32(i), jnp.int32(page * self.cfg.page_size),
+            size=self.cfg.page_size,
+        )
+        self._fps_memo = None
+
+    def _verify_pages(self) -> None:
+        """Recompute completed-page checksums for every active slot; a
+        mismatch is cache-block corruption — typed event + evict for
+        bit-exact re-prefill (run every iteration to guarantee no token
+        computed from a damaged page is ever emitted)."""
+        if not any(s.rid is not None and s.page_fp for s in self.slots):
+            return
+        fps = self._page_fps()
+        for i, slot in enumerate(self.slots):
+            if slot.rid is None:
+                continue
+            for p, fp in slot.page_fp.items():
+                if float(fps[i, p]) != fp:
+                    self.reg.counter("serve_corruptions").inc()
+                    self.reg.emit(
+                        "serve_corruption", rid=slot.rid, slot=i, page=p,
+                        iteration=self._it,
+                    )
+                    self._evict(slot.rid, reason="corruption")
+                    break
+
+    # ------------------------------------------------------------------
+    # bookkeeping
+    # ------------------------------------------------------------------
+    def _page_fps(self) -> np.ndarray:
+        """The (slots, n_pages) checksum table — one call, one transfer,
+        memoized per cache version (every site that replaces self.cache
+        resets ``_fps_memo``), so a decode that completes a page and the
+        next iteration's verifier pass share ONE reduction."""
+        if self._fps_memo is None:
+            self._fps_memo = np.asarray(self._fingerprint_fn(self.cache))
+        return self._fps_memo
+
+    def _maybe_complete(self, slot_i: int, now: float | None = None) -> None:
+        slot = self.slots[slot_i]
+        rid = slot.rid
+        if rid is None:
+            return
+        req = self.requests[rid]
+        res = self.results[rid]
+        done = len(res.tokens) >= self._eff_max_new[rid] or (
+            req.eos_id is not None and res.tokens and res.tokens[-1] == req.eos_id
+        )
+        if done:
+            self._release_slot(rid)
+            self._finish(rid, RequestState.DONE, None, now=now)
+
+    def _release_slot(self, rid: str) -> None:
+        for slot in self.slots:
+            if slot.rid == rid:
+                slot.rid = None
+                slot.frontier = 0
+                slot.page_fp = {}
+        self.alloc.free(rid)
+
+    def _finish(
+        self, rid: str, state: RequestState, error, now: float | None = None
+    ) -> None:
+        res = self.results[rid]
+        res.state = state
+        res.error = error
+        res.finished_t = self.clock() if now is None else now
+        # Terminal: drop all per-request host state except the result
+        # itself (kept until the caller reads/drains it) — a long-running
+        # server must not grow with total requests served.
+        self._deadline.pop(rid, None)
+        self._eff_max_new.pop(rid, None)
+        self.requests.pop(rid, None)
+        self.reg.counter(f"serve_{state.value}").inc()
+        if state is RequestState.DONE and res.ms_per_token is not None:
+            self.reg.histogram("serve_ms_per_token").observe(res.ms_per_token)
+        self.reg.emit("serve_request", iteration=self._it, **res.summary())
+
+    def _on_retry_event(self, etype: str, **fields: Any) -> None:
+        self.reg.counter("serve_retries").inc()
+        for rid in self._retry_scope:
+            self.results[rid].n_retries += 1
+        self.bus.post(etype, **fields)
+
+    def _drain_bus(self) -> None:
+        for etype, fields in self.bus.drain():
+            if etype == "chaos":
+                self.reg.counter("chaos_injections").inc()
+            elif etype == "recovery":
+                self.reg.counter("recoveries").inc()
+            fields.setdefault("iteration", self._it)
+            self.reg.emit(etype, **fields)

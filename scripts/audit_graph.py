@@ -48,6 +48,13 @@ def main() -> int:
         help="also audit the greedy decode entry point",
     )
     p.add_argument(
+        "--serve", action="store_true",
+        help="also audit the serving (continuous-batching) decode step — "
+        "its recompile fingerprint admits a request BETWEEN the two "
+        "measured executions, so cold==1/steady==0 proves admission at "
+        "fixed slots never recompiles",
+    )
+    p.add_argument(
         "--check-baselines", action="store_true",
         help="fail when a committed baseline is missing (drift always "
         "checks against whatever baselines exist)",
@@ -90,7 +97,8 @@ def main() -> int:
     findings = []
     artifacts = []
     for art in build_artifacts(
-        modes, decode=args.decode, execute=not args.no_execute
+        modes, decode=args.decode, serve=args.serve,
+        execute=not args.no_execute
     ):
         artifacts.append(art)
         found = audit_artifact(art)

@@ -4,25 +4,37 @@
 # test failure; prints DOTS_PASSED=<n> for the no-worse-than-seed check.
 #
 # Pre-gate 1: the MoE-dispatch/HLO-collective suites (ISSUE 3), the decode
-# fast-path surfaces (ISSUE 4), and the graph-auditor suite (ISSUE 5) must
-# COLLECT. The main run passes `--continue-on-collection-errors`, under
-# which an import error in one file still fails the run but buries the
-# cause at the bottom of a long log; failing fast here names the broken
-# file first. Collection is cheap (no tests execute).
+# fast-path surfaces (ISSUE 4), the graph-auditor suite (ISSUE 5), and the
+# serving runtime (ISSUE 6) must COLLECT. The main run passes
+# `--continue-on-collection-errors`, under which an import error in one
+# file still fails the run but buries the cause at the bottom of a long
+# log; failing fast here names the broken file first. Collection is cheap
+# (no tests execute).
 timeout -k 10 120 env JAX_PLATFORMS=cpu python -m pytest --collect-only -q -p no:cacheprovider \
   tests/test_moe.py tests/test_collectives_hlo.py \
-  tests/test_generate.py tests/test_metrics.py tests/test_analysis.py > /dev/null || {
-    echo "tier-1 pre-gate: MoE/HLO/decode/analysis test collection failed" >&2; exit 1; }
-# Pre-gate 2 (ISSUE 5): the graph audit — lower/compile the dp/tp/fsdp/ep
-# train steps (8-virtual-device CPU mesh) AND the greedy decode scan, run
-# the rule engine (collective census, donation, dtype, host-sync lint,
-# recompile), and gate on ALL committed baselines under
-# dtc_tpu/analysis/baselines/. ~2-3 min on this 1-core host; runs
-# anywhere (JAX_PLATFORMS=cpu, no accelerator). On an INTENDED graph
-# change: re-bless with
-#   python scripts/audit_graph.py --modes dp,tp,fsdp,ep --decode --write-baseline
+  tests/test_generate.py tests/test_metrics.py tests/test_analysis.py \
+  tests/test_serve.py > /dev/null || {
+    echo "tier-1 pre-gate: MoE/HLO/decode/analysis/serve test collection failed" >&2; exit 1; }
+# Pre-gate 2 (ISSUE 5 + 6): the graph audit — lower/compile the
+# dp/tp/fsdp/ep train steps (8-virtual-device CPU mesh), the greedy decode
+# scan, AND the serving (continuous-batching) decode step; run the rule
+# engine (collective census, donation, dtype, host-sync lint, recompile)
+# and gate on ALL committed baselines under dtc_tpu/analysis/baselines/.
+# The serve entry's recompile fingerprint ADMITS a request between its two
+# measured executions, so its cold==1/steady==0 baseline proves admission
+# at fixed slots never recompiles the decode step. ~2-3 min on this
+# 1-core host; runs anywhere (JAX_PLATFORMS=cpu, no accelerator). On an
+# INTENDED graph change: re-bless with
+#   python scripts/audit_graph.py --modes dp,tp,fsdp,ep --decode --serve --write-baseline
 # and commit the baseline diff.
-timeout -k 10 420 env JAX_PLATFORMS=cpu python scripts/audit_graph.py \
-  --modes dp,tp,fsdp,ep --decode --check-baselines || {
+timeout -k 10 480 env JAX_PLATFORMS=cpu python scripts/audit_graph.py \
+  --modes dp,tp,fsdp,ep --decode --serve --check-baselines || {
     echo "tier-1 pre-gate: graph audit failed (see findings above)" >&2; exit 1; }
+# Pre-gate 3 (ISSUE 6): fast scheduler smoke — four requests (two sharing
+# a system-prompt prefix) through the real continuous-batching engine on
+# the tiny audit model, every output asserted token-for-token identical
+# to generate(). ~30-60 s; catches a broken scheduler before the long
+# main run buries it.
+timeout -k 10 240 env JAX_PLATFORMS=cpu python scripts/serve_smoke.py || {
+    echo "tier-1 pre-gate: serving scheduler smoke failed" >&2; exit 1; }
 set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc
