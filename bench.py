@@ -253,12 +253,55 @@ def decode_bench(
     }
 
 
-def _pct(vals: list, q: float) -> float | None:
-    """Nearest-rank percentile (q in [0, 1]) over a small sample."""
-    if not vals:
-        return None
-    vals = sorted(vals)
-    return vals[min(int(q * len(vals)), len(vals) - 1)]
+from dtc_tpu.utils.percentile import nearest_rank as _pct  # noqa: E402
+# _pct: shared nearest-rank percentile (ISSUE 7 satellite) — one
+# definition for bench, scripts/trace_report.py, and the registry-
+# histogram parity tests. Serving-row percentiles below now come from
+# the registry's log-bucketed histograms instead of private sample
+# lists; _pct remains the exact oracle for small host-side samples
+# (trace_overhead_bench).
+
+
+def trace_overhead_bench(steps: int = 200) -> dict:
+    """Measure the tracing substrate's per-step host cost: the full
+    telemetry hook cycle (step clock + step event + span synthesis +
+    JSONL write) with spans ON vs OFF, p50 over ``steps`` iterations.
+    Pure host-side — the span path adds zero device syncs by design, so
+    per-step microseconds here over the benched step time IS the
+    tracing overhead (PERF.md records the %)."""
+    import tempfile
+    import time as _t
+
+    from dtc_tpu.config.schema import ObsConfig
+    from dtc_tpu.obs import Telemetry
+
+    def loop(trace: bool) -> float:
+        times = []
+        with tempfile.TemporaryDirectory(prefix="dtc_trace_ovh_") as d:
+            tele = Telemetry(
+                ObsConfig(trace=trace, memory_sample_every=0), output_dir=d,
+            )
+            try:
+                for s in range(1, steps + 1):
+                    t0 = _t.perf_counter()
+                    tele.on_step_start(s)
+                    with tele.clock.phase("data_wait"):
+                        pass
+                    with tele.clock.phase("dispatch"):
+                        pass
+                    tele.on_step_end(s, elapsed_s=0.0, synced=True)
+                    times.append(_t.perf_counter() - t0)
+            finally:
+                tele.close()
+        return float(_pct(times, 0.5))
+
+    on, off = loop(True), loop(False)
+    return {
+        "steps": steps,
+        "us_per_step_traced": round(on * 1e6, 2),
+        "us_per_step_untraced": round(off * 1e6, 2),
+        "span_overhead_us_per_step": round((on - off) * 1e6, 2),
+    }
 
 
 def serve_bench(
@@ -327,9 +370,13 @@ def serve_bench(
         for _ in range(n_requests)
     ]
     # Warm the compiled surfaces outside the measured window (one
-    # admission + one decode step), so row 1 doesn't pay the jit tax.
+    # admission + one decode step), so row 1 doesn't pay the jit tax —
+    # then drop the warm request's samples from the SLO histograms so
+    # the measured percentiles cover only the row's own requests.
     eng.submit(Request(rid="warm", prompt=prompts[0], max_new_tokens=2))
     eng.run(max_steps=16)
+    for name in ("serve_ttft_s", "serve_ms_per_token", "serve_queue_wait_s"):
+        eng.reg.histogram(name).reset()
 
     rejected = 0
     i = 0
@@ -358,9 +405,13 @@ def serve_bench(
     done = [r for r in res if r.state is RequestState.DONE]
     by_state = lambda s: sum(1 for r in res if r.state.value == s)  # noqa: E731
     tokens_out = sum(len(r.tokens) for r in done)
-    ttft = [r.ttft_s for r in done if r.ttft_s is not None]
-    mspt = [r.ms_per_token for r in done if r.ms_per_token is not None]
-    qwait = [r.queue_wait_s for r in done if r.queue_wait_s is not None]
+    # Percentiles from the REGISTRY histograms — the same log-bucketed
+    # instruments serve/telemetry reports live — not private sample
+    # lists (ISSUE 7). ttft/queue-wait cover every request that reached
+    # a first token (the SLO population); ms/token covers completed
+    # requests (matching the old done-only list). Values are within one
+    # ~10% bucket of exact nearest-rank (parity-tested in test_trace).
+    q = lambda name, p: eng.reg.histogram(name).percentile(p)  # noqa: E731
     r4 = lambda v: None if v is None else round(v, 4)  # noqa: E731
     return {
         "rps": None if rps is None else round(rps, 3),
@@ -379,12 +430,12 @@ def serve_bench(
         "evictions": sum(r.n_evictions for r in res),
         "wall_s": round(wall, 3),
         "sustained_tokens_per_sec": round(tokens_out / wall, 1) if wall else None,
-        "ttft_p50_s": r4(_pct(ttft, 0.50)),
-        "ttft_p99_s": r4(_pct(ttft, 0.99)),
-        "ms_per_token": r4(_pct(mspt, 0.50)),
-        "ms_per_token_p99": r4(_pct(mspt, 0.99)),
-        "queue_wait_p50_s": r4(_pct(qwait, 0.50)),
-        "queue_wait_p99_s": r4(_pct(qwait, 0.99)),
+        "ttft_p50_s": r4(q("serve_ttft_s", 0.50)),
+        "ttft_p99_s": r4(q("serve_ttft_s", 0.99)),
+        "ms_per_token": r4(q("serve_ms_per_token", 0.50)),
+        "ms_per_token_p99": r4(q("serve_ms_per_token", 0.99)),
+        "queue_wait_p50_s": r4(q("serve_queue_wait_s", 0.50)),
+        "queue_wait_p99_s": r4(q("serve_queue_wait_s", 0.99)),
         "platform": jax.devices()[0].platform,
         "serve_model": model_label,
     }
@@ -659,6 +710,7 @@ def main(argv: list[str] | None = None) -> None:
 
     if args.serve_only:
         serve_bench_rows(emit, seed=args.serve_seed, **serve_cfg_kw)
+        emit("trace_overhead", _safe("trace_overhead", trace_overhead_bench))
         extra = {
             "devices": jax.device_count(),
             "device_kind": jax.devices()[0].device_kind,
@@ -758,6 +810,9 @@ def main(argv: list[str] | None = None) -> None:
     # continuous-batching engine at calibrated offered loads, including
     # one past saturation — the row that shows shedding holds p99.
     serve_bench_rows(emit, seed=args.serve_seed, **serve_cfg_kw)
+    # Tracing substrate cost (ISSUE 7): host-side span-emission µs per
+    # step, A/B traced vs untraced — PERF.md reads the % off this row.
+    emit("trace_overhead", _safe("trace_overhead", trace_overhead_bench))
     emit("ring_block_smoke", _safe("ring_block_smoke", ring_block_smoke))
 
     # Assemble the detail line FROM the registry's event stream: each

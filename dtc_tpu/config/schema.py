@@ -216,6 +216,20 @@ class ObsConfig:
     # top-level TrainConfig.profile_start/profile_stop are used.
     profile_start: int = 0
     profile_stop: int = 0
+    # --- spans + flight recorder (dtc_tpu/obs/trace.py, ISSUE 7) ---
+    # Host-side span events (per-step phase timeline in training, per-
+    # request waterfall in serving; export with scripts/trace_report.py
+    # --perfetto). Reuses timestamps the runtimes already measure — no
+    # extra device syncs; measured overhead is in PERF.md.
+    trace: bool = True
+    # Flight recorder: bounded ring of the last N events, dumped
+    # atomically to <obs dir>/flight.r<k>.json on anomaly-guard trip,
+    # watchdog fire, SIGTERM, or unhandled crash. 0 disables.
+    flight_recorder: int = 256
+    # Rotate the JSONL shard once the live file crosses this many MB
+    # (segments events.r<k>.jsonl.1, .2, …; readers discover them).
+    # 0 = never rotate (legacy single-file shard).
+    rotate_mb: float = 0.0
 
     def __post_init__(self) -> None:
         if self.memory_sample_every < 0:
@@ -224,6 +238,49 @@ class ObsConfig:
             raise ValueError(
                 f"straggler_threshold must be >= 1.0, got {self.straggler_threshold}"
             )
+        if self.flight_recorder < 0:
+            raise ValueError("flight_recorder must be >= 0 (0 = off)")
+        if self.rotate_mb < 0:
+            raise ValueError("rotate_mb must be >= 0 (0 = no rotation)")
+
+
+@dataclass(frozen=True)
+class SloConfig:
+    """Online SLO monitor (``dtc_tpu/obs/slo.py``): objectives evaluated
+    over sliding windows DURING the run, emitting typed ``slo_breach`` /
+    ``slo_recovered`` events the serving scheduler's degrade policy
+    reacts to. A threshold of 0 disables that objective; with every
+    objective off (the default) no monitor is constructed. Serving
+    objectives: ``ttft_p99_s``, ``ms_per_token_p99``,
+    ``queue_wait_p99_s``, ``shed_rate``; training objectives:
+    ``step_time_p99_s``, ``data_wait_p99_s``."""
+
+    enabled: bool = True
+    window: int = 64        # samples per objective's sliding window
+    min_samples: int = 4    # don't judge an objective on fewer samples
+    check_every: int = 8    # evaluate every N scheduler iterations / steps
+    # -- serving objectives (seconds / ms / fraction; 0 = off) --
+    ttft_p99_s: float = 0.0
+    ms_per_token_p99: float = 0.0
+    queue_wait_p99_s: float = 0.0
+    shed_rate: float = 0.0
+    # -- training objectives (seconds; 0 = off) --
+    step_time_p99_s: float = 0.0
+    data_wait_p99_s: float = 0.0
+
+    def __post_init__(self) -> None:
+        if self.window < 2:
+            raise ValueError("slo window must be >= 2")
+        if self.min_samples < 1:
+            raise ValueError("slo min_samples must be >= 1")
+        if self.check_every < 1:
+            raise ValueError("slo check_every must be >= 1")
+        for f in ("ttft_p99_s", "ms_per_token_p99", "queue_wait_p99_s",
+                  "step_time_p99_s", "data_wait_p99_s"):
+            if getattr(self, f) < 0:
+                raise ValueError(f"slo {f} must be >= 0 (0 = off)")
+        if not 0.0 <= self.shed_rate <= 1.0:
+            raise ValueError("slo shed_rate must be in [0, 1] (0 = off)")
 
 
 @dataclass(frozen=True)
@@ -413,6 +470,10 @@ class ServeConfig:
         default_factory=lambda: WatchdogConfig(enabled=True)
     )
     chaos: ChaosConfig = field(default_factory=ChaosConfig)
+    # Online SLO objectives (obs/slo.py): evaluated every check_every
+    # scheduler iterations; a breaching latency objective activates the
+    # graceful-degradation cap exactly like crossing degrade_watermark.
+    slo: SloConfig = field(default_factory=SloConfig)
 
     def __post_init__(self) -> None:
         if self.slots < 1:
@@ -509,8 +570,11 @@ class TrainConfig:
     profile_start: int = 0       # capture jax.profiler trace [start, stop)
     profile_stop: int = 0
     # Telemetry subsystem (JSONL events, step breakdown, memory sampling,
-    # multi-host reduction) — see ObsConfig above.
+    # multi-host reduction, spans + flight recorder) — see ObsConfig above.
     obs: ObsConfig = field(default_factory=ObsConfig)
+    # Online SLO objectives for training (step-time / data-wait p99 over
+    # sliding windows -> typed slo_breach events) — see SloConfig above.
+    slo: SloConfig = field(default_factory=SloConfig)
     # Fault tolerance: anomaly guard, watchdog, stream retry, chaos
     # injection — see ResilienceConfig above and README "Fault tolerance".
     resilience: ResilienceConfig = field(default_factory=ResilienceConfig)

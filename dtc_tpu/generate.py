@@ -194,6 +194,7 @@ def generate(
     temperature: float = 0.0,
     top_k: int | None = None,
     top_p: float | None = None,
+    tracer=None,
 ) -> jax.Array:
     """See :func:`_generate_impl` for semantics; this wrapper picks the
     compiled path. With ``cfg.debug_checks`` the model emits
@@ -201,7 +202,26 @@ def generate(
     functionalized before jit — this path discharges them and throws,
     trading per-call recompiles for dev-mode assertions. The static
     length validation above makes the check unreachable from THIS API;
-    it protects direct ``model.apply(..., decode=True)`` callers."""
+    it protects direct ``model.apply(..., decode=True)`` callers.
+
+    ``tracer`` (an :class:`dtc_tpu.obs.trace.Tracer`) wraps the whole
+    compiled call in one ``generate`` span — the prefill+scan is a
+    single jit, so finer host-side splits would be fiction; per-token
+    attribution lives in the serving engine's iteration spans and
+    ``scripts/profile_step.py --decode``."""
+    if tracer is not None and tracer.enabled:
+        with tracer.span(
+            "generate", cat="generate", batch=int(prompt.shape[0]),
+            prompt_len=int(prompt.shape[1]), new_tokens=int(max_new_tokens),
+        ):
+            out = generate(
+                model, params, prompt, max_new_tokens, rng,
+                temperature=temperature, top_k=top_k, top_p=top_p,
+            )
+            # Sync INSIDE the span so it measures device work, not the
+            # async dispatch returning (the bracketed call is host-side).
+            jax.block_until_ready(out)
+            return out
     if getattr(model.cfg, "debug_checks", False):
         from jax.experimental import checkify
 

@@ -1,10 +1,14 @@
 """Unified telemetry subsystem.
 
-Structured metrics (counters/gauges/timers/histograms), a JSONL event
-stream with a back-compat CSV bridge, step-time breakdown with compile /
-recompile tracking, device HBM sampling, a hardened profiler window, and
-multi-host shard reduction with straggler detection. See the README's
-"Observability" section for the event schema and config knobs.
+Structured metrics (counters/gauges/timers/histograms — histograms carry
+log-bucketed p50/p90/p99), a JSONL event stream with size-based rotation
+and a back-compat CSV bridge, step-time breakdown with compile /
+recompile tracking, host-side spans with a crash-surviving flight
+recorder and Chrome-trace/Perfetto export, an online SLO monitor, device
+HBM sampling, a hardened profiler window, and multi-host shard reduction
+with straggler detection (serving-aware). See the README's
+"Observability" section for the event schema and config knobs;
+``scripts/trace_report.py`` is the offline trace analyzer.
 """
 
 from dtc_tpu.obs.aggregate import find_shards, reduce_shards, shard_path
@@ -16,24 +20,39 @@ from dtc_tpu.obs.registry import (
     MemorySink,
     MetricsRegistry,
     read_jsonl,
+    rotated_segments,
 )
+from dtc_tpu.obs.slo import Objective, SloMonitor
 from dtc_tpu.obs.stepclock import CompileWatcher, StepClock
 from dtc_tpu.obs.telemetry import Telemetry
+from dtc_tpu.obs.trace import (
+    FlightRecorder,
+    Tracer,
+    load_flight_dump,
+    to_chrome_trace,
+)
 
 __all__ = [
     "CompileWatcher",
     "CsvSink",
+    "FlightRecorder",
     "JsonlSink",
     "MemorySink",
     "MetricsRegistry",
+    "Objective",
+    "SloMonitor",
     "StepClock",
     "StepWindowProfiler",
     "Telemetry",
+    "Tracer",
     "find_shards",
+    "load_flight_dump",
     "max_stat",
     "peak_hbm_bytes",
     "read_jsonl",
     "reduce_shards",
+    "rotated_segments",
     "sample_memory",
     "shard_path",
+    "to_chrome_trace",
 ]

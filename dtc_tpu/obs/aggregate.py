@@ -29,7 +29,13 @@ def shard_path(obs_dir: str, process_index: int) -> str:
 
 
 def find_shards(obs_dir: str) -> dict[int, str]:
-    """Process index -> shard path for every shard visible in ``obs_dir``."""
+    """Process index -> LOGICAL shard path for every shard in ``obs_dir``.
+
+    The returned path is the live file; size-rotated segments
+    (``events.r<k>.jsonl.1``, …) are part of the same logical shard and
+    are expanded — in chronological order — by
+    :func:`dtc_tpu.obs.registry.read_jsonl`, so every consumer of this
+    mapping reads rotated history transparently."""
     shards = {}
     for p in glob.glob(os.path.join(obs_dir, "events.r*.jsonl")):
         m = _SHARD_RE.search(p)
@@ -48,13 +54,51 @@ def _step_times(events: list[dict[str, Any]]) -> dict[int, float]:
     }
 
 
+#: Serving event types whose presence marks a shard as a serving run
+#: (and whose ``iteration`` stamps bound the scheduler's progress).
+_SERVE_ETYPES = ("serve_request", "serve_admit", "serve_evict",
+                 "serve_reject", "serve_corruption")
+
+
+def _serve_stats(events: list[dict[str, Any]]) -> dict[str, Any] | None:
+    """Per-shard serving reduction: terminal request counts by state and
+    the highest scheduler iteration observed. ``None`` when the shard
+    holds no serving events at all."""
+    iterations = 0
+    requests = 0
+    by_state: dict[str, int] = {}
+    seen = False
+    for e in events:
+        et = e.get("etype")
+        if et not in _SERVE_ETYPES:
+            continue
+        seen = True
+        it = e.get("iteration")
+        if isinstance(it, (int, float)):
+            iterations = max(iterations, int(it))
+        if et == "serve_request":
+            requests += 1
+            state = str(e.get("state", "?"))
+            by_state[state] = by_state.get(state, 0) + 1
+    if not seen:
+        return None
+    return {"requests": requests, "iterations": iterations,
+            "by_state": by_state}
+
+
 def reduce_shards(
     obs_dir: str, straggler_threshold: float = 1.5
 ) -> dict[str, Any] | None:
     """Cross-host reduction of every shard under ``obs_dir``.
 
-    Returns ``None`` when no shard holds step events (e.g. a run that
-    died before its first step). Otherwise::
+    Returns ``None`` only when no shard holds training step events OR
+    serving events (e.g. a run that died before its first step).
+    Training shards reduce to the per-host step-time table below;
+    serving shards additionally (or, for serving-only runs, instead)
+    contribute a typed ``"serve"`` summary — a serving-only run used to
+    reduce to ``None`` silently, indistinguishable from a run that did
+    nothing. Mixed fleets (some hosts training, some serving) get both
+    sections. Training shape::
 
         {
           "hosts": {proc: {"steps": N, "mean_step_time_s": ..,
@@ -64,16 +108,58 @@ def reduce_shards(
           "stragglers": [proc, ...],
           "straggler_threshold": ..,
           "n_hosts": N,
+          # when serving events exist anywhere:
+          "serve": {"requests": R, "iterations": I, "by_state": {...}},
         }
+
+    Serving-only shape: ``hosts`` entries carry ``steps: 0`` +
+    ``serve_requests``, ``training_steps: 0`` states it explicitly, and
+    ``stragglers`` stays empty (straggler detection is defined on step
+    times).
     """
     shards = find_shards(obs_dir)
     per_host: dict[int, dict[int, float]] = {}
+    serve_host: dict[int, dict[str, Any]] = {}
     for proc, path in sorted(shards.items()):
-        times = _step_times(read_jsonl(path))
+        events = read_jsonl(path)
+        times = _step_times(events)
         if times:
             per_host[proc] = times
+        serve = _serve_stats(events)
+        if serve is not None:
+            serve_host[proc] = serve
+    serve_total = None
+    if serve_host:
+        by_state: dict[str, int] = {}
+        for s in serve_host.values():
+            for k, v in s["by_state"].items():
+                by_state[k] = by_state.get(k, 0) + v
+        serve_total = {
+            "requests": sum(s["requests"] for s in serve_host.values()),
+            "iterations": max(s["iterations"] for s in serve_host.values()),
+            "by_state": by_state,
+        }
     if not per_host:
-        return None
+        if serve_total is None:
+            return None
+        # Serving-only run: the explicit "no training steps, K serve
+        # iterations" summary (ISSUE 7 satellite).
+        hosts = {
+            str(proc): {
+                "steps": 0,
+                "serve_requests": s["requests"],
+                "straggler": False,
+            }
+            for proc, s in serve_host.items()
+        }
+        return {
+            "hosts": hosts,
+            "stragglers": [],
+            "straggler_threshold": straggler_threshold,
+            "n_hosts": len(serve_host),
+            "training_steps": 0,
+            "serve": serve_total,
+        }
 
     host_means = {
         proc: sum(t.values()) / len(t) for proc, t in per_host.items()
@@ -96,8 +182,14 @@ def reduce_shards(
             "max_step_time_s": round(max(times.values()), 6),
             "straggler": lagging,
         }
+    # Mixed fleet: serving-only hosts still appear in the table.
+    for proc, s in serve_host.items():
+        entry = hosts.setdefault(
+            str(proc), {"steps": 0, "straggler": False}
+        )
+        entry["serve_requests"] = s["requests"]
     means = list(host_means.values())
-    return {
+    out = {
         "hosts": hosts,
         "step_time_s": {
             "mean": round(sum(means) / len(means), 6),
@@ -107,5 +199,8 @@ def reduce_shards(
         },
         "stragglers": sorted(stragglers),
         "straggler_threshold": straggler_threshold,
-        "n_hosts": len(per_host),
+        "n_hosts": len(set(per_host) | set(serve_host)),
     }
+    if serve_total is not None:
+        out["serve"] = serve_total
+    return out

@@ -51,16 +51,41 @@ class Gauge:
         self.value = v if v is None else float(v)
 
 
+#: Log-bucket growth factor for Histogram quantiles: each bucket spans
+#: ~10% relative width, so any reported pNN is within one 10% bucket of
+#: the exact nearest-rank value (the parity tests pin this bound).
+HIST_BUCKET_GROWTH = 1.1
+_LOG_GROWTH = math.log(HIST_BUCKET_GROWTH)
+
+
 class Histogram:
-    """Streaming summary (count/sum/min/max + mean) — enough for step-time
-    spread without holding per-step samples for a 5000-step run."""
+    """Streaming summary with fixed log-bucketed quantiles.
+
+    Originally count/sum/min/max only — which could not answer the
+    p50/p99 questions the serving SLOs are phrased in, forcing bench.py
+    to hold private per-request sample lists. Observations now also land
+    in log-spaced buckets (relative width ``HIST_BUCKET_GROWTH``-1 ≈ 10%,
+    O(hundreds) of buckets over the microsecond..hour range, O(1) per
+    observe), so ``percentile(q)`` answers within one bucket width of the
+    exact nearest-rank value without retaining samples for a 5000-step
+    (or million-request) run. ``summary()`` keeps the original keys
+    byte-compatible and adds ``p50/p90/p99``.
+    """
 
     def __init__(self, name: str):
         self.name = name
+        self.reset()
+
+    def reset(self) -> None:
+        """Forget every observation (bench uses this to drop warmup
+        samples measured through the same engine/registry)."""
         self.count = 0
         self.total = 0.0
         self.min: float | None = None
         self.max: float | None = None
+        # bucket index -> count; non-positive values (durations clamp at
+        # 0.0) share one underflow bucket keyed None.
+        self._buckets: dict[int | None, int] = {}
 
     def observe(self, v: float) -> None:
         v = float(v)
@@ -70,10 +95,33 @@ class Histogram:
         self.total += v
         self.min = v if self.min is None else min(self.min, v)
         self.max = v if self.max is None else max(self.max, v)
+        idx = None if v <= 0.0 else math.floor(math.log(v) / _LOG_GROWTH)
+        self._buckets[idx] = self._buckets.get(idx, 0) + 1
 
     @property
     def mean(self) -> float | None:
         return self.total / self.count if self.count else None
+
+    def percentile(self, q: float) -> float | None:
+        """Nearest-rank quantile over the bucketed counts: the returned
+        value is the geometric midpoint of the bucket holding the
+        nearest-rank sample (clamped to the observed [min, max]), so it
+        is within one bucket width of the exact sample value."""
+        if not self.count:
+            return None
+        if not 0.0 <= q <= 1.0:
+            raise ValueError(f"quantile must be in [0, 1], got {q}")
+        rank = max(1, math.ceil(q * self.count))
+        seen = 0
+        # The None (<= 0) bucket holds the smallest values — walk it first.
+        for idx in sorted(self._buckets, key=lambda i: (i is not None, i)):
+            seen += self._buckets[idx]
+            if seen >= rank:
+                if idx is None:
+                    return max(0.0, self.min if self.min is not None else 0.0)
+                mid = math.exp((idx + 0.5) * _LOG_GROWTH)
+                return min(max(mid, self.min), self.max)
+        return self.max  # unreachable: counts always cover rank
 
     def summary(self) -> dict[str, float | int | None]:
         return {
@@ -82,6 +130,9 @@ class Histogram:
             "min": self.min,
             "max": self.max,
             "total": self.total,
+            "p50": self.percentile(0.50),
+            "p90": self.percentile(0.90),
+            "p99": self.percentile(0.99),
         }
 
 
@@ -112,19 +163,53 @@ class JsonlSink:
     The shard name encodes the process index (``events.r<k>.jsonl``) so the
     process-0 reducer can discover sibling shards on a shared filesystem
     and still degrade to single-shard mode when there is only its own.
+
+    ``max_bytes > 0`` enables size-based rotation: once the live file
+    crosses the threshold it is renamed to the next numbered segment
+    (``events.r0.jsonl.1``, ``.2``, … — chronological order, newest
+    segment highest) and a fresh live file opened, so a long serving run
+    does not grow one unbounded file per process. Readers
+    (:func:`read_jsonl`, :func:`dtc_tpu.obs.aggregate.find_shards`)
+    discover the rotated segments transparently.
     """
 
-    def __init__(self, path: str, append: bool = False):
+    def __init__(self, path: str, append: bool = False, max_bytes: int = 0):
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
         self.path = path
+        self.max_bytes = int(max_bytes)
         # append=True on resumed runs: truncating would wipe the preempted
         # run's events — the prefix the crash-survival contract preserved.
         self._fh: IO | None = open(path, "a" if append else "w")
+        self._size = os.path.getsize(path) if append else 0
 
     def write(self, event: dict[str, Any]) -> None:
         if self._fh is None:
             return
-        self._fh.write(json.dumps(event, sort_keys=False) + "\n")
+        line = json.dumps(event, sort_keys=False) + "\n"
+        self._fh.write(line)
+        self._size += len(line)
+        if self.max_bytes > 0 and self._size >= self.max_bytes:
+            self._rotate()
+
+    def _rotate(self) -> None:
+        """Seal the live file as the next numbered segment. Rotation never
+        renames existing segments (a crash mid-rotation loses nothing);
+        a rename failure (exotic filesystems) degrades to no rotation
+        rather than losing the stream."""
+        assert self._fh is not None
+        self._fh.close()
+        n = 1
+        while os.path.exists(f"{self.path}.{n}"):
+            n += 1
+        try:
+            os.replace(self.path, f"{self.path}.{n}")
+        except OSError as e:
+            print(f"[dtc_tpu] WARNING: JSONL rotation failed ({e})")
+            self._fh = open(self.path, "a")
+            self.max_bytes = 0  # don't retry every write
+            return
+        self._fh = open(self.path, "w")
+        self._size = 0
 
     def flush(self) -> None:
         if self._fh:
@@ -205,6 +290,13 @@ class MetricsRegistry:
         self._sinks.append(sink)
         return sink
 
+    def set_clock(self, clock: Callable[[], float]) -> None:
+        """Repoint the ``ts`` stamp at a runtime's own clock. The serving
+        engine does this so event ``ts``, span ``t0``, and the SLO
+        timings on its results all share ONE timebase (tests inject fake
+        clocks; the trace exporter orders by these stamps)."""
+        self._clock = clock
+
     # -- instruments ------------------------------------------------------
     def counter(self, name: str) -> Counter:
         return self._counters.setdefault(name, Counter(name))
@@ -253,18 +345,38 @@ class MetricsRegistry:
         self._sinks = []
 
 
+def rotated_segments(path: str) -> list[str]:
+    """Every on-disk file of one logical shard, chronologically: rotated
+    segments ``path.1``, ``path.2``, … (numeric order) then the live
+    ``path`` itself — only files that exist."""
+    import glob as _glob
+    import re as _re
+
+    segs = []
+    for p in _glob.glob(f"{path}.*"):
+        m = _re.fullmatch(_re.escape(path) + r"\.(\d+)", p)
+        if m:
+            segs.append((int(m.group(1)), p))
+    out = [p for _, p in sorted(segs)]
+    if os.path.exists(path):
+        out.append(path)
+    return out
+
+
 def read_jsonl(path: str) -> list[dict[str, Any]]:
-    """Parse a JSONL shard, skipping any torn final line (a crashed or
-    still-running writer leaves one; the stream's whole point is surviving
-    that)."""
+    """Parse one logical JSONL shard — rotated segments included, in
+    chronological order — skipping any torn final line per file (a
+    crashed or still-running writer leaves one; the stream's whole point
+    is surviving that)."""
     events = []
-    with open(path) as f:
-        for line in f:
-            line = line.strip()
-            if not line:
-                continue
-            try:
-                events.append(json.loads(line))
-            except json.JSONDecodeError:
-                continue
+    for seg in rotated_segments(path) or [path]:
+        with open(seg) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    events.append(json.loads(line))
+                except json.JSONDecodeError:
+                    continue
     return events

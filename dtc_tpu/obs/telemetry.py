@@ -71,11 +71,15 @@ import json
 import os
 from typing import Any
 
+import time
+
 from dtc_tpu.obs.aggregate import reduce_shards, shard_path
 from dtc_tpu.obs.device import peak_hbm_bytes, sample_memory
 from dtc_tpu.obs.profiling import StepWindowProfiler
 from dtc_tpu.obs.registry import CsvSink, JsonlSink, MetricsRegistry
+from dtc_tpu.obs.slo import SloMonitor
 from dtc_tpu.obs.stepclock import CompileWatcher, StepClock
+from dtc_tpu.obs.trace import FlightRecorder, Tracer
 
 
 class Telemetry:
@@ -88,6 +92,7 @@ class Telemetry:
         process_index: int = 0,
         profiler: StepWindowProfiler | None = None,
         append: bool = False,
+        slo_cfg: Any = None,
     ):
         from dtc_tpu.config.schema import ObsConfig
 
@@ -105,15 +110,39 @@ class Telemetry:
         self._steady = False
         self._jsonl: JsonlSink | None = None
         self._closed = False
+        # Even with JSONL off, anomaly dumps need a destination.
+        self._dump_dir = (
+            self.cfg.dir or (os.path.join(output_dir, "obs") if output_dir else "")
+        )
         if self.cfg.enabled and self.cfg.jsonl and output_dir:
             self.obs_dir = self.cfg.dir or os.path.join(output_dir, "obs")
             try:
                 self._jsonl = self.registry.add_sink(
-                    JsonlSink(shard_path(self.obs_dir, process_index), append=append)
+                    JsonlSink(
+                        shard_path(self.obs_dir, process_index), append=append,
+                        max_bytes=int(self.cfg.rotate_mb * 1e6),
+                    )
                 )
             except OSError as e:  # unwritable dir: observe-or-ignore, never crash
                 print(f"[dtc_tpu] WARNING: telemetry JSONL disabled ({e})")
                 self.obs_dir = ""
+        # Spans + flight recorder (ISSUE 7). Span events ride the same
+        # sinks; the recorder is a bounded in-memory ring dumped only at
+        # anomaly time, so "always on" costs one deque append per event.
+        self.tracer = Tracer(
+            self.registry, enabled=self.cfg.enabled and self.cfg.trace,
+            clock=time.time, tid="train",
+        )
+        self.recorder: FlightRecorder | None = None
+        if self.cfg.enabled and self.cfg.flight_recorder > 0:
+            self.recorder = self.registry.add_sink(
+                FlightRecorder(self.cfg.flight_recorder)
+            )
+        # Online SLO monitor (training objectives); None with all off.
+        self.slo = SloMonitor.from_config(
+            slo_cfg, self.registry, runtime="train"
+        )
+        self._slo_check_every = getattr(slo_cfg, "check_every", 8) or 8
         self.compiles.activate()
 
     # -- construction -----------------------------------------------------
@@ -146,6 +175,7 @@ class Telemetry:
             process_index=process_index,
             profiler=profiler,
             append=resumed,
+            slo_cfg=getattr(train_cfg, "slo", None),
         )
 
     @classmethod
@@ -209,6 +239,41 @@ class Telemetry:
             **breakdown,
             **extra,
         )
+        # Step/phase spans, synthesized from the breakdown the clock
+        # ALREADY measured (no extra syncs, one wall-clock read). The
+        # phases run in loop order data_wait -> dispatch -> block, so
+        # laying them end to end from the step start is exact up to the
+        # interleaved host overhead other_s accounts for.
+        if self.tracer.enabled:
+            t1 = time.time()
+            t0 = t1 - breakdown["step_time_s"]
+            self.tracer.emit_span(
+                "step", t0, t1, cat="train", tid="train", step=step
+            )
+            cursor = t0
+            for ph in ("data_wait", "dispatch", "block"):
+                d = breakdown[f"{ph}_s"]
+                if d > 0:
+                    self.tracer.emit_span(
+                        ph, cursor, cursor + d, cat="train",
+                        tid="train.phase", step=step,
+                    )
+                    cursor += d
+            # Only a STEADY-state recompile gets its span here; the
+            # warmup-less first step's cold compile went through
+            # _note_startup_compile above, which already emitted the
+            # startup compile span — emitting both would double-count
+            # compile seconds in the attribution table.
+            if extra.get("recompile"):
+                self.tracer.emit_span(
+                    "compile", t1 - compile_s, t1, cat="train",
+                    tid="train.compile", step=step, recompile=True,
+                )
+        if self.slo is not None:
+            self.slo.observe("step_time_s", breakdown["step_time_s"])
+            self.slo.observe("data_wait_s", breakdown["data_wait_s"])
+            if step % self._slo_check_every == 0:
+                self.slo.evaluate(step=step)
         every = self.cfg.memory_sample_every
         if self.cfg.enabled and every > 0 and step % every == 0:
             self.sample_memory(step)
@@ -244,6 +309,15 @@ class Telemetry:
         self.registry.emit(
             "compile", step=0, compile_time_s=round(compile_s, 4), count=n
         )
+        if self.tracer.enabled:
+            # Timeline placement is approximate (the compile seconds
+            # accumulated over init/warmup, ending no later than now) —
+            # the span's value is its DURATION on the startup track.
+            t1 = time.time()
+            self.tracer.emit_span(
+                "compile", t1 - compile_s, t1, cat="train",
+                tid="train.compile", step=0, count=n,
+            )
 
     def on_window(self, step: int, *, avg_step_s: float, tokens_per_sec: float,
                   mfu: float | None) -> None:
@@ -269,11 +343,40 @@ class Telemetry:
             loss=loss,
             **({} if duration_s is None else {"duration_s": round(duration_s, 4)}),
         )
+        if duration_s is not None and self.tracer.enabled:
+            t1 = time.time()
+            self.tracer.emit_span(
+                "eval", t1 - duration_s, t1, cat="train", tid="eval",
+                step=step, loss=round(loss, 4),
+            )
+
+    def span(self, name: str, **attrs: Any):
+        """Bracket a trainer phase (checkpoint save, rollback) as a span —
+        a no-op context manager when tracing is off."""
+        return self.tracer.span(name, cat="train", **attrs)
+
+    # -- flight recorder ---------------------------------------------------
+    def dump_flight(self, reason: str, **meta: Any) -> str | None:
+        """Dump the flight-recorder ring to ``<obs dir>/flight.r<k>.json``
+        (atomic; last dump wins the filename, every dump records its
+        reason). None when the recorder is off or there is nowhere to
+        write."""
+        if self.recorder is None or not self._dump_dir:
+            return None
+        path = os.path.join(
+            self._dump_dir, f"flight.r{self.registry.process_index}.json"
+        )
+        try:
+            return self.recorder.dump(path, reason=reason, **meta)
+        except OSError as e:  # post-mortem aid must never kill the run
+            print(f"[dtc_tpu] WARNING: flight-recorder dump failed ({e})")
+            return None
 
     # -- resilience hooks --------------------------------------------------
     def on_anomaly(self, step: int, *, reason: str, action: str) -> None:
         self.registry.counter("anomalies").inc()
         self.registry.emit("anomaly", step=step, reason=reason, action=action)
+        self.dump_flight(f"anomaly: {reason}", step=step, action=action)
 
     def on_recovery(self, step: int, *, action: str, **fields: Any) -> None:
         self.registry.counter("recoveries").inc()
@@ -282,6 +385,7 @@ class Telemetry:
     def on_hung_step(self, step: int, **fields: Any) -> None:
         self.registry.counter("hung_steps").inc()
         self.registry.emit("hung_step", step=step, **fields)
+        self.dump_flight("hung_step", step=step)
 
     def drain_recovery_bus(self, bus: Any, step: int) -> None:
         """Move pending chaos/recovery records (posted from threads and

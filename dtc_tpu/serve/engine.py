@@ -52,6 +52,8 @@ import numpy as np
 
 from dtc_tpu.generate import decode_step, init_cache
 from dtc_tpu.obs.registry import MetricsRegistry
+from dtc_tpu.obs.slo import SloMonitor
+from dtc_tpu.obs.trace import FlightRecorder, Tracer
 from dtc_tpu.resilience.chaos import ChaosInjector
 from dtc_tpu.resilience.events import RecoveryBus
 from dtc_tpu.resilience.retry import retry_call
@@ -144,6 +146,33 @@ class ServingEngine:
         self.reg: MetricsRegistry = (
             telemetry.registry if telemetry is not None else MetricsRegistry()
         )
+        # ONE timebase for the whole serving record: event ts stamps,
+        # span t0s, and the SLO timings on ServeResult all read the
+        # scheduler clock (injected fake clocks stay coherent in tests).
+        # Emission adds a constant epoch offset so the scheduler's
+        # monotonic seconds land on the wall clock the TRAINER's shards
+        # use — cross-host / mixed train+serve timeline merges sort by
+        # raw timestamp, and a monotonic-since-boot base would place
+        # every serve event decades before every train event. A constant
+        # shift cancels in every duration/difference, so span-derived
+        # TTFT/queue-wait still equal the ServeResult values exactly.
+        self._epoch0 = time.time() - self.clock()
+        emit_clock = lambda: self.clock() + self._epoch0  # noqa: E731
+        self.reg.set_clock(emit_clock)
+        if telemetry is not None:
+            self.tracer = telemetry.tracer
+            self.tracer.clock = emit_clock
+            self.recorder = telemetry.recorder
+        else:
+            # Engine used bare (tests, bench): spans still emit to the
+            # registry and the flight recorder still rings in memory.
+            self.tracer = Tracer(self.reg, clock=emit_clock, tid="sched")
+            self.recorder = self.reg.add_sink(FlightRecorder(256))
+        # Online SLO monitor — evaluated at iteration boundaries; a
+        # breaching latency objective activates graceful degradation.
+        slo_cfg = getattr(cfg, "slo", None)
+        self.slo = SloMonitor.from_config(slo_cfg, self.reg, runtime="serve")
+        self._slo_check_every = getattr(slo_cfg, "check_every", 8) or 8
         self.bus = RecoveryBus()
         self.chaos = (
             ChaosInjector(cfg.chaos, self.bus) if cfg.chaos.enabled else None
@@ -400,12 +429,19 @@ class ServingEngine:
         # watchdog: idle polling spins are microsecond-scale, and letting
         # them into the trailing median would flag every healthy decode
         # iteration of an interleaved submit()/step() caller as hung.
+        # Bus drain BEFORE the watchdog verdict: chaos/recovery records
+        # posted during this iteration land in the stream (and their
+        # flight dumps fire) first, so a stall-then-flag iteration's LAST
+        # dump carries the most diagnostic reason (hung_step).
+        self._drain_bus()
         if self.watchdog is not None and self._worked:
             flag = self.watchdog.observe(self._it, self.clock() - t0)
             if flag is not None:
                 self.reg.counter("serve_hung_steps").inc()
                 self.reg.emit("hung_step", runtime="serve", **flag)
-        self._drain_bus()
+                self.dump_flight("hung_step", iteration=self._it)
+        if self.slo is not None and self._it % self._slo_check_every == 0:
+            self.slo.evaluate(iteration=self._it)
         return bool(self.queue) or any(s.rid is not None for s in self.slots)
 
     def run(self, *, max_steps: int = 100_000) -> dict[str, ServeResult]:
@@ -591,16 +627,21 @@ class ServingEngine:
 
     def _do_admit(self, req: Request, slot_i: int, seq: list[int]) -> None:
         self._worked = True  # a prefill runs whatever the outcome
+        t_adm = self.clock()
         res = self.results[req.rid]
         res.state = RequestState.PREFILL
         if req.rid not in self._eff_max_new:
             eff = req.max_new_tokens
-            if (
+            over_queue = (
                 self.cfg.degrade_watermark > 0
-                and self.cfg.degrade_max_new_tokens > 0
                 and (len(self.queue) + 1) / self.cfg.queue_depth
                 > self.cfg.degrade_watermark
-            ):
+            )
+            # A breaching latency SLO degrades new admissions exactly like
+            # crossing the queue watermark — the scheduler reacting to the
+            # online monitor instead of a post-hoc bench row.
+            slo_hot = self.slo is not None and self.slo.degrade_active
+            if self.cfg.degrade_max_new_tokens > 0 and (over_queue or slo_hot):
                 eff = min(eff, self.cfg.degrade_max_new_tokens)
                 if eff < req.max_new_tokens:
                     res.degraded = True
@@ -650,6 +691,27 @@ class ServingEngine:
             self.reg.histogram("serve_queue_wait_s").observe(
                 res.queue_wait_s or 0.0
             )
+            if self.slo is not None:
+                self.slo.observe("serve_ttft_s", res.ttft_s)
+                self.slo.observe("serve_queue_wait_s", res.queue_wait_s)
+        # Request waterfall spans: queued (submit — or last eviction — to
+        # this admission) then prefill, on the request's own track. All
+        # edges are timestamps already taken above: zero extra clock work
+        # beyond t_adm. Explicit None checks: an injected clock may
+        # legitimately read 0.0 at submit.
+        q0 = res.requeued_t
+        if q0 is None:
+            q0 = res.submitted_t if res.submitted_t is not None else t_adm
+        self.tracer.emit_span(
+            "req.queued", self._ts(q0), self._ts(t_adm),
+            cat="serve", tid=req.rid, rid=req.rid, iteration=self._it,
+        )
+        res.requeued_t = None
+        self.tracer.emit_span(
+            "req.prefill", self._ts(t_adm), self._ts(now), cat="serve",
+            tid=req.rid, rid=req.rid,
+            resident=len(seq), prefix_len=base_len, slot=slot_i,
+        )
         self.last_tok[slot_i] = tok
         self.reg.counter("serve_admissions").inc()
         self.reg.emit(
@@ -691,6 +753,7 @@ class ServingEngine:
         if not active:
             return
         self._worked = True
+        t_dec = self.clock()
         prev_cache = self.cache  # kept alive so a retry re-runs bit-exactly
         toks = jnp.asarray(self.last_tok)
         last_fin = np.ones((self.cfg.slots,), bool)
@@ -742,6 +805,12 @@ class ServingEngine:
         self.cache = cache
         self._fps_memo = None
         now = self.clock()
+        # Scheduler-side decode-iteration span (one per iteration over
+        # the whole in-flight batch — the Orca iteration waterfall).
+        self.tracer.emit_span(
+            "decode_step", self._ts(t_dec), self._ts(now), cat="serve",
+            tid="sched", iteration=self._it, batch=len(active),
+        )
         completed_pages = []  # (slot_i, page) finished this step
         for i, rid in active:
             slot = self.slots[i]
@@ -773,6 +842,9 @@ class ServingEngine:
         res = self.results[rid]
         res.state = RequestState.EVICTED  # observable until re-admission
         res.n_evictions += 1
+        # The next req.queued span starts HERE, not at submit — the
+        # waterfall shows the evict→requeue→re-prefill chain as segments.
+        res.requeued_t = self.clock()
         self.queue.insert(0, self.requests[rid])
         self.reg.counter("serve_evictions").inc()
         self.reg.emit(
@@ -830,7 +902,11 @@ class ServingEngine:
                         "serve_corruption", rid=slot.rid, slot=i, page=p,
                         iteration=self._it,
                     )
-                    self._evict(slot.rid, reason="corruption")
+                    rid = slot.rid
+                    self._evict(rid, reason="corruption")
+                    self.dump_flight(
+                        "serve_corruption", rid=rid, iteration=self._it
+                    )
                     break
 
     # ------------------------------------------------------------------
@@ -883,7 +959,29 @@ class ServingEngine:
         self.reg.counter(f"serve_{state.value}").inc()
         if state is RequestState.DONE and res.ms_per_token is not None:
             self.reg.histogram("serve_ms_per_token").observe(res.ms_per_token)
+            if self.slo is not None:
+                self.slo.observe("serve_ms_per_token", res.ms_per_token)
+        if self.slo is not None:
+            self.slo.observe_outcome(
+                "serve_outcome_shed", state is RequestState.SHED
+            )
+        # Close the request's span chain: the decode span (first token →
+        # terminal, spanning any eviction gaps — the evict instants mark
+        # those) and a terminal instant naming the outcome.
+        if res.first_token_t is not None:
+            self.tracer.emit_span(
+                "req.decode", self._ts(res.first_token_t),
+                self._ts(res.finished_t), cat="serve",
+                tid=rid, rid=rid, n_tokens=len(res.tokens),
+            )
+        self.tracer.instant(
+            f"req.{state.value}", cat="serve", tid=rid,
+            t=self._ts(res.finished_t),
+            rid=rid, error=type(error).__name__ if error else None,
+        )
         self.reg.emit("serve_request", iteration=self._it, **res.summary())
+        if state is RequestState.FAILED:
+            self.dump_flight(f"request_failed: {rid}", rid=rid)
 
     def _on_retry_event(self, etype: str, **fields: Any) -> None:
         self.reg.counter("serve_retries").inc()
@@ -891,10 +989,28 @@ class ServingEngine:
             self.results[rid].n_retries += 1
         self.bus.post(etype, **fields)
 
+    def _ts(self, t: float) -> float:
+        """Scheduler-clock timestamp -> the emission (epoch) timebase —
+        the constant shift that makes serve spans sortable against
+        trainer shards (see __init__); durations are unaffected."""
+        return t + self._epoch0
+
+    def dump_flight(self, reason: str, **meta: Any) -> str | None:
+        """Dump the flight-recorder ring (telemetry owns the file path;
+        bare engines keep the ring in memory for the caller/tests)."""
+        if self.telemetry is not None:
+            return self.telemetry.dump_flight(reason, **meta)
+        return None
+
     def _drain_bus(self) -> None:
         for etype, fields in self.bus.drain():
             if etype == "chaos":
                 self.reg.counter("chaos_injections").inc()
+                # Every injected fault leaves a timeline: the post-mortem
+                # the flight recorder exists for, exercised by chaos.
+                self.dump_flight(
+                    f"chaos: {fields.get('kind', '?')}", iteration=self._it
+                )
             elif etype == "recovery":
                 self.reg.counter("recoveries").inc()
             fields.setdefault("iteration", self._it)

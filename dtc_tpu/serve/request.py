@@ -143,6 +143,9 @@ class ServeResult:
     n_evictions: int = 0
     n_retries: int = 0
     degraded: bool = False               # max_new_tokens shrunk at admission
+    # Eviction re-queue time: the next req.queued trace span starts here
+    # instead of at submit (cleared on re-admission; never in summary()).
+    requeued_t: float | None = None
 
     @property
     def queue_wait_s(self) -> float | None:

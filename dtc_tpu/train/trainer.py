@@ -856,8 +856,16 @@ def _train(
                         # handler's effect instead.
                         stop_requested["flag"] = True
                 stopping = stop_requested["flag"]
-                if stopping and lead:
-                    print(f"[dtc_tpu] stopping at step {step} (SIGTERM)")
+                if stopping:
+                    # Preemption post-mortem: the last-N-events timeline,
+                    # dumped before the checkpoint/flush work below (which
+                    # the preemptor may not leave time for). Drain the bus
+                    # first so the chaos/recovery records that triggered
+                    # the stop are IN the dumped timeline.
+                    tele.drain_recovery_bus(bus, step)
+                    tele.dump_flight("sigterm", step=step)
+                    if lead:
+                        print(f"[dtc_tpu] stopping at step {step} (SIGTERM)")
 
                 if step % train_cfg.log_every == 0 or step == train_cfg.steps or stopping:
                     # Re-arm the hard timeout for the boundary's loss
@@ -1005,7 +1013,8 @@ def _train(
                             )
                     else:
                         tele.registry.counter("checkpoints").inc()
-                        ckpt.save(step, state)  # waits + writes integrity manifest
+                        with tele.span("checkpoint", step=step):
+                            ckpt.save(step, state)  # waits + writes integrity manifest
                         sidecar_out = stream_position_sidecar(step)
                         if sidecar_out is not None:
                             # Per-process: each pod host's stream position
@@ -1028,6 +1037,10 @@ def _train(
         except KeyboardInterrupt as e:
             # The watchdog's hard-timeout monitor interrupts the main
             # thread; surface it as the typed abort, telemetry closed.
+            tele.dump_flight(
+                "watchdog_timeout" if (wd is not None and wd.timed_out)
+                else "interrupt"
+            )
             tele.close()
             if wd is not None and wd.timed_out:
                 raise WatchdogTimeout(
@@ -1035,9 +1048,12 @@ def _train(
                     f"({res_cfg.watchdog.hard_timeout_s}s)"
                 ) from e
             raise
-        except BaseException:
+        except BaseException as e:
             # A crashed run still keeps its flushed JSONL prefix — same
-            # crash-survival contract as the incremental CSV.
+            # crash-survival contract as the incremental CSV — plus a
+            # flight-recorder dump so the post-mortem starts from a
+            # timeline, not a truncated log.
+            tele.dump_flight(f"crash: {type(e).__name__}")
             tele.close()
             raise
         finally:

@@ -1,4 +1,8 @@
 from dtc_tpu.utils.metrics import gpt_step_flops, mfu, peak_flops_per_chip
 from dtc_tpu.utils.logging import CSVLogger
+from dtc_tpu.utils.percentile import nearest_rank
 
-__all__ = ["gpt_step_flops", "mfu", "peak_flops_per_chip", "CSVLogger"]
+__all__ = [
+    "gpt_step_flops", "mfu", "peak_flops_per_chip", "CSVLogger",
+    "nearest_rank",
+]

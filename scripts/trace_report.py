@@ -1,0 +1,313 @@
+#!/usr/bin/env python
+"""Offline trace analyzer (ISSUE 7): waterfalls, attribution, Perfetto.
+
+Reads a run's telemetry (``<run>/obs/events.r*.jsonl`` — every process
+shard, rotated segments included — merged into one cross-host timeline)
+and answers the post-hoc questions the online monitor can't:
+
+- **span attribution table** (default): where the wall-clock went, per
+  span name — count, total, mean, p50/p99/max — slowest first. The
+  p50/p99 here are exact nearest-rank over the raw span durations (the
+  same shared definition bench uses), so they double as the oracle for
+  the registry's bucketed histograms.
+- **--waterfall**: per-request timelines for serving runs (queued →
+  prefill → decode spans plus evict/chaos/corruption/terminal marks,
+  offsets relative to submit) and the per-step phase summary for
+  training runs.
+- **--perfetto OUT.json**: Chrome-trace export — load in
+  https://ui.perfetto.dev (or chrome://tracing). Tracks are request ids
+  / trainer phases; instants mark chaos, recovery, SLO breaches.
+- **--compare OTHER_RUN**: span-summary and histogram-percentile diff
+  between two runs (the regression-hunting view).
+- **--flight**: pretty-print the newest flight-recorder dump.
+
+    python scripts/trace_report.py outputs/run1 [--waterfall]
+        [--slowest 15] [--perfetto /tmp/trace.json]
+        [--compare outputs/run2] [--flight]
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from dtc_tpu.obs.aggregate import find_shards  # noqa: E402
+from dtc_tpu.obs.registry import read_jsonl  # noqa: E402
+from dtc_tpu.obs.trace import _event_time, to_chrome_trace  # noqa: E402
+from dtc_tpu.utils.percentile import nearest_rank  # noqa: E402
+
+
+def resolve_obs_dir(run_dir: str) -> str:
+    """Accept either the run's output dir or its obs/ dir directly."""
+    if find_shards(run_dir):
+        return run_dir
+    sub = os.path.join(run_dir, "obs")
+    if find_shards(sub):
+        return sub
+    raise SystemExit(
+        f"no events.r*.jsonl under {run_dir} or {run_dir}/obs — was the "
+        "run's obs.jsonl telemetry enabled?"
+    )
+
+
+def load_events(run_dir: str) -> list[dict]:
+    """All shards (all processes, rotated segments included), merged into
+    one timeline ordered by each event's own timestamp — the cross-host
+    merge is a sort because every event carries proc + ts/t0."""
+    obs_dir = resolve_obs_dir(run_dir)
+    events = []
+    for _proc, path in sorted(find_shards(obs_dir).items()):
+        events.extend(read_jsonl(path))
+    events.sort(key=lambda e: (_event_time(e) is None, _event_time(e) or 0.0))
+    return events
+
+
+def spans_of(events: list[dict]) -> list[dict]:
+    return [e for e in events if e.get("etype") == "span" and e.get("ph") != "i"]
+
+
+# ---------------------------------------------------------------------------
+# attribution
+
+
+def span_table(events: list[dict]) -> list[dict]:
+    """Per-name duration attribution, slowest total first."""
+    groups: dict[tuple, list[float]] = {}
+    for e in spans_of(events):
+        groups.setdefault((str(e.get("cat") or ""), str(e["name"])), []).append(
+            float(e.get("dur_s") or 0.0)
+        )
+    rows = []
+    for (cat, name), durs in groups.items():
+        rows.append({
+            "cat": cat,
+            "name": name,
+            "count": len(durs),
+            "total_s": round(sum(durs), 6),
+            "mean_s": round(sum(durs) / len(durs), 6),
+            "p50_s": round(nearest_rank(durs, 0.50), 6),
+            "p99_s": round(nearest_rank(durs, 0.99), 6),
+            "max_s": round(max(durs), 6),
+        })
+    rows.sort(key=lambda r: -r["total_s"])
+    return rows
+
+
+def print_span_table(rows: list[dict], top: int = 20) -> None:
+    if not rows:
+        print("no spans found (obs.trace off, or a pre-ISSUE-7 run)")
+        return
+    hdr = f"{'span':<28}{'n':>6}{'total_s':>11}{'mean_s':>10}{'p50_s':>10}{'p99_s':>10}{'max_s':>10}"
+    print(hdr)
+    print("-" * len(hdr))
+    for r in rows[:top]:
+        label = f"{r['cat']}/{r['name']}" if r["cat"] else r["name"]
+        print(
+            f"{label:<28}{r['count']:>6}{r['total_s']:>11.4f}"
+            f"{r['mean_s']:>10.5f}{r['p50_s']:>10.5f}{r['p99_s']:>10.5f}"
+            f"{r['max_s']:>10.5f}"
+        )
+
+
+# ---------------------------------------------------------------------------
+# waterfalls
+
+
+def request_waterfalls(events: list[dict]) -> dict[str, list[dict]]:
+    """rid -> ordered timeline entries (spans + attached marks)."""
+    out: dict[str, list[dict]] = {}
+    for e in events:
+        etype = e.get("etype")
+        rid = e.get("rid")
+        if not rid:
+            continue
+        if etype == "span":
+            entry = {
+                "kind": "span" if e.get("ph") != "i" else "mark",
+                "name": str(e["name"]),
+                "t": float(e.get("t0") or 0.0),
+                "dur_s": float(e.get("dur_s") or 0.0),
+            }
+        # (slo_breach events carry no rid — they are run-scoped marks,
+        # visible in the Perfetto export, not on per-request waterfalls.)
+        elif etype in ("serve_evict", "serve_corruption", "chaos",
+                       "recovery"):
+            entry = {
+                "kind": "mark",
+                "name": etype + (
+                    f":{e['reason']}" if etype == "serve_evict" and "reason" in e
+                    else ""
+                ),
+                "t": float(_event_time(e) or 0.0),
+                "dur_s": 0.0,
+            }
+        else:
+            continue
+        out.setdefault(str(rid), []).append(entry)
+    for entries in out.values():
+        entries.sort(key=lambda x: x["t"])
+    return out
+
+
+def print_waterfalls(events: list[dict], width: int = 48) -> None:
+    falls = request_waterfalls(events)
+    if not falls:
+        print("no per-request spans (training-only run?) — see the span table")
+        return
+    for rid, entries in falls.items():
+        t0 = min(x["t"] for x in entries)
+        t1 = max(x["t"] + x["dur_s"] for x in entries)
+        total = max(t1 - t0, 1e-9)
+        print(f"\nrequest {rid}  ({total:.4f}s submit->terminal)")
+        for x in entries:
+            off = x["t"] - t0
+            if x["kind"] == "span":
+                lo = int(off / total * width)
+                ln = max(int(x["dur_s"] / total * width), 1)
+                bar = " " * lo + "#" * min(ln, width - lo)
+                print(
+                    f"  {x['name']:<22}{off:>9.4f}s {x['dur_s']:>9.4f}s |{bar:<{width}}|"
+                )
+            else:
+                lo = min(int(off / total * width), width - 1)
+                bar = " " * lo + "^"
+                print(
+                    f"  {x['name']:<22}{off:>9.4f}s {'':>10} |{bar:<{width}}|"
+                )
+
+
+# ---------------------------------------------------------------------------
+# compare
+
+
+def _last_run_summary(events: list[dict]) -> dict:
+    out = {}
+    for e in events:
+        if e.get("etype") == "run_summary":
+            out = e
+    return out
+
+
+def compare_runs(events_a: list[dict], events_b: list[dict]) -> list[dict]:
+    """Span p50/p99 + histogram-percentile deltas, A -> B (positive pct =
+    B slower)."""
+    ta = {(r["cat"], r["name"]): r for r in span_table(events_a)}
+    tb = {(r["cat"], r["name"]): r for r in span_table(events_b)}
+    rows = []
+    for key in sorted(set(ta) | set(tb)):
+        a, b = ta.get(key), tb.get(key)
+        row = {
+            "kind": "span",
+            "name": f"{key[0]}/{key[1]}" if key[0] else key[1],
+            "count_a": a["count"] if a else 0,
+            "count_b": b["count"] if b else 0,
+            "p50_a": a["p50_s"] if a else None,
+            "p50_b": b["p50_s"] if b else None,
+            "p99_a": a["p99_s"] if a else None,
+            "p99_b": b["p99_s"] if b else None,
+        }
+        if a and b and a["p50_s"]:
+            row["p50_delta_pct"] = round((b["p50_s"] / a["p50_s"] - 1) * 100, 1)
+        rows.append(row)
+    sa, sb = _last_run_summary(events_a), _last_run_summary(events_b)
+    for key in sorted(set(sa) & set(sb)):
+        va, vb = sa[key], sb[key]
+        if not (isinstance(va, dict) and isinstance(vb, dict) and "p50" in va):
+            continue
+        row = {
+            "kind": "histogram", "name": key,
+            "count_a": va.get("count"), "count_b": vb.get("count"),
+            "p50_a": va.get("p50"), "p50_b": vb.get("p50"),
+            "p99_a": va.get("p99"), "p99_b": vb.get("p99"),
+        }
+        if va.get("p50") and vb.get("p50") is not None:
+            row["p50_delta_pct"] = round((vb["p50"] / va["p50"] - 1) * 100, 1)
+        rows.append(row)
+    return rows
+
+
+def print_compare(rows: list[dict]) -> None:
+    hdr = (f"{'metric':<34}{'n(A)':>6}{'n(B)':>6}{'p50(A)':>11}{'p50(B)':>11}"
+           f"{'p99(A)':>11}{'p99(B)':>11}{'dP50%':>8}")
+    print(hdr)
+    print("-" * len(hdr))
+    fmt = lambda v: "-" if v is None else f"{v:.5f}"  # noqa: E731
+    for r in rows:
+        print(
+            f"{r['kind'][0]}:{r['name']:<32}{r['count_a'] or 0:>6}"
+            f"{r['count_b'] or 0:>6}{fmt(r['p50_a']):>11}{fmt(r['p50_b']):>11}"
+            f"{fmt(r['p99_a']):>11}{fmt(r['p99_b']):>11}"
+            f"{r.get('p50_delta_pct', '-'):>8}"
+        )
+
+
+# ---------------------------------------------------------------------------
+# CLI
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[1])
+    ap.add_argument("run_dir", help="run output dir (or its obs/ dir)")
+    ap.add_argument("--waterfall", action="store_true",
+                    help="per-request waterfalls (serving runs)")
+    ap.add_argument("--slowest", type=int, default=20, metavar="N",
+                    help="rows in the attribution table (default 20)")
+    ap.add_argument("--perfetto", metavar="OUT.json", default="",
+                    help="write a Chrome-trace/Perfetto JSON export")
+    ap.add_argument("--compare", metavar="RUN_B", default="",
+                    help="diff span/percentile summaries against a second run")
+    ap.add_argument("--flight", action="store_true",
+                    help="print the newest flight-recorder dump")
+    args = ap.parse_args(argv)
+
+    events = load_events(args.run_dir)
+    n_spans = len(spans_of(events))
+    procs = sorted({e.get("proc", 0) for e in events})
+    print(
+        f"# {len(events)} events / {n_spans} spans from "
+        f"{len(procs)} process shard(s) under {args.run_dir}"
+    )
+
+    if args.flight:
+        obs_dir = resolve_obs_dir(args.run_dir)
+        dumps = sorted(
+            glob.glob(os.path.join(obs_dir, "flight.r*.json")),
+            key=os.path.getmtime,
+        )
+        if not dumps:
+            print("no flight-recorder dump (the run saw no anomaly)")
+        else:
+            with open(dumps[-1]) as f:
+                body = json.load(f)
+            print(
+                f"\nflight dump {os.path.basename(dumps[-1])}: "
+                f"reason={body['reason']!r}, {body['n_events']} events"
+            )
+            for e in body["events"][-15:]:
+                print(f"  {e.get('etype'):<16}{json.dumps(e)[:110]}")
+
+    if args.compare:
+        print_compare(compare_runs(events, load_events(args.compare)))
+        return 0
+
+    print_span_table(span_table(events), top=args.slowest)
+    if args.waterfall:
+        print_waterfalls(events)
+    if args.perfetto:
+        trace = to_chrome_trace(events)
+        with open(args.perfetto, "w") as f:
+            json.dump(trace, f)
+        print(
+            f"# wrote {len(trace['traceEvents'])} trace events to "
+            f"{args.perfetto} (open in https://ui.perfetto.dev)"
+        )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

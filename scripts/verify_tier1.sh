@@ -13,8 +13,8 @@
 timeout -k 10 120 env JAX_PLATFORMS=cpu python -m pytest --collect-only -q -p no:cacheprovider \
   tests/test_moe.py tests/test_collectives_hlo.py \
   tests/test_generate.py tests/test_metrics.py tests/test_analysis.py \
-  tests/test_serve.py > /dev/null || {
-    echo "tier-1 pre-gate: MoE/HLO/decode/analysis/serve test collection failed" >&2; exit 1; }
+  tests/test_serve.py tests/test_trace.py > /dev/null || {
+    echo "tier-1 pre-gate: MoE/HLO/decode/analysis/serve/trace test collection failed" >&2; exit 1; }
 # Pre-gate 2 (ISSUE 5 + 6): the graph audit — lower/compile the
 # dp/tp/fsdp/ep train steps (8-virtual-device CPU mesh), the greedy decode
 # scan, AND the serving (continuous-batching) decode step; run the rule
@@ -37,4 +37,12 @@ timeout -k 10 480 env JAX_PLATFORMS=cpu python scripts/audit_graph.py \
 # main run buries it.
 timeout -k 10 240 env JAX_PLATFORMS=cpu python scripts/serve_smoke.py || {
     echo "tier-1 pre-gate: serving scheduler smoke failed" >&2; exit 1; }
+# Pre-gate 4 (ISSUE 7): tracing smoke — 3 training steps + 2 serve
+# requests with tracing on, then the offline leg: trace_report's loaders
+# must produce a span attribution table, per-request waterfalls
+# (queued->prefill->decode->done for every request), and a Perfetto
+# export with the required ph/ts/dur/pid/tid/name keys and monotonic
+# timestamps. ~1-2 min; catches a broken span/export pipeline early.
+timeout -k 10 300 env JAX_PLATFORMS=cpu python scripts/trace_smoke.py || {
+    echo "tier-1 pre-gate: tracing smoke failed" >&2; exit 1; }
 set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc

@@ -777,11 +777,15 @@ def test_fused_decode_attention_per_row_matches_oracle():
 # ---------------------------------------------------------------------------
 
 def test_bench_pct_helper():
+    """bench's _pct is now the SHARED textbook nearest-rank helper
+    (dtc_tpu/utils/percentile.py, ISSUE 7): rank = ceil(q*n), so the
+    even-sample median is the lower neighbor (2.0, not the old ad-hoc
+    int(q*n) indexing's 3.0). Edge cases live in test_trace.py."""
     from bench import _pct
 
     assert _pct([], 0.5) is None
     assert _pct([3.0], 0.99) == 3.0
-    assert _pct([1.0, 2.0, 3.0, 4.0], 0.5) == 3.0
+    assert _pct([1.0, 2.0, 3.0, 4.0], 0.5) == 2.0
     assert _pct([1.0, 2.0, 3.0, 4.0], 0.99) == 4.0
 
 
