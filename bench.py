@@ -304,6 +304,90 @@ def trace_overhead_bench(steps: int = 200) -> dict:
     }
 
 
+def devprof_bench(capture_steps: int = 3) -> dict:
+    """Device-time attribution row for the b8 reference train step
+    (ISSUE 8): a programmatic devprof capture around ``capture_steps``
+    steps of the SAME flagship b8 workload as ``reference_workload_b8``,
+    rolled up to components via the compiled module's op_name metadata.
+
+    Gated STRUCTURALLY, not on raw timings (CPU wall clocks swing ±30%
+    on the CI host; op structure does not): every dot/conv-class op must
+    attribute to a model component and the unattributed share must stay
+    under 10% — plus the warn-band cross-check against the static
+    collective census (``comm_bytes_per_step``), the dynamic counterpart
+    of the graph auditor's collective rules.
+    """
+    import jax
+    from flax import linen as nn
+
+    from dtc_tpu.obs import devprof
+    from dtc_tpu.utils.metrics import (
+        comm_bytes_per_step, gpt_step_flops, peak_flops_per_chip,
+    )
+    from scripts.bench_common import build_step
+
+    step_fn, state, batch, key, (mesh, rules), model_cfg = build_step(
+        batch=8, remat=False
+    )
+    import tempfile
+
+    with tempfile.TemporaryDirectory(prefix="dtc_devprof_bench_") as trace_dir:
+        with mesh, nn.logical_axis_rules(rules):
+            # AOT lower+compile: the SAME executable runs the capture and
+            # yields the optimized-HLO text whose per-instruction op_name
+            # metadata recovers scope paths for the trace's bare op names.
+            rng = jax.random.fold_in(key, 0)
+            compiled = step_fn.lower(state, batch, rng).compile()
+            hlo_text = compiled.as_text()
+            out = compiled(state, batch, rng)  # warmup (donates state)
+            jax.block_until_ready(out[1])
+            comm = comm_bytes_per_step(
+                model_cfg, 8, model_cfg.max_seq_len,
+                {k: int(v) for k, v in mesh.shape.items()}, "dp",
+            )
+            with devprof.CaptureWindow(
+                trace_dir, steps=capture_steps, reason="bench_b8",
+                step_flops=gpt_step_flops(model_cfg, 8, model_cfg.max_seq_len),
+                peak_flops=peak_flops_per_chip(),
+                comm_estimate=comm,
+            ) as cap:
+                for _ in range(capture_steps):
+                    out = compiled(out[0], batch, rng)
+                jax.block_until_ready(out[1])
+        if not cap.ok:
+            return {"error": "profiler capture failed (see warning above)"}
+        analysis = devprof.analyze_capture(trace_dir, hlo_text=hlo_text)
+        if analysis is None:
+            return {"error": "capture produced no trace file"}
+    att = analysis["attribution"]
+    gates = devprof.structural_gates(att)
+    warnings = devprof.census_crosscheck(att, comm)
+    for w in warnings:
+        print(f"# devprof census warning: {w}")
+    meta = analysis["meta"]
+    mfu_dev = att.device_mfu(
+        meta.get("step_flops"), meta.get("peak_flops"), capture_steps
+    )
+    return {
+        "capture_steps": capture_steps,
+        "device_s_per_step": round(att.total_s / capture_steps, 6),
+        "device_busy_s_per_step": round(att.busy_s / capture_steps, 6),
+        "component_share": {
+            r["component"]: r["share"] for r in att.component_table()
+        },
+        "phase_share": {
+            k: round(v / att.total_s, 4) for k, v in sorted(att.phases.items())
+        } if att.total_s else {},
+        "overlap_ratio": round(att.overlap_ratio, 4),
+        "unattributed_share": gates["unattributed_share"],
+        "all_dot_fusions_attributed": gates["all_dot_fusions_attributed"],
+        "unattributed_share_ok": gates["unattributed_share_ok"],
+        "census_warnings": warnings,
+        "device_mfu": None if mfu_dev is None else round(mfu_dev, 4),
+        "peak_hbm_bytes": meta.get("peak_hbm_bytes"),
+    }
+
+
 def serve_bench(
     rps: float | None,
     *,
@@ -679,6 +763,12 @@ def main(argv: list[str] | None = None) -> None:
         "bench still includes them)",
     )
     ap.add_argument(
+        "--devprof-only", action="store_true",
+        help="run ONLY the device-time attribution row + trace overhead "
+        "(ISSUE 8 — the CPU-measured observatory artifact path while the "
+        "TPU tunnel is down; the full bench still includes them)",
+    )
+    ap.add_argument(
         "--serve-model", default="flagship", choices=("flagship", "tiny"),
         help="model for the serving rows: flagship (TPU-scale) or tiny "
         "(the audit/test model — scheduler metrics are model-agnostic and "
@@ -707,6 +797,24 @@ def main(argv: list[str] | None = None) -> None:
         )
     else:
         serve_cfg_kw = dict(model_cfg=None, model_label="flagship")
+
+    if args.devprof_only:
+        emit("devprof_b8", _safe("devprof_b8", devprof_bench))
+        emit("trace_overhead", _safe("trace_overhead", trace_overhead_bench))
+        extra = {
+            "devices": jax.device_count(),
+            "device_kind": jax.devices()[0].device_kind,
+        }
+        for ev in sink.events:
+            if ev["etype"] != "bench_config":
+                continue
+            extra[ev["label"]] = {
+                k: v for k, v in ev.items()
+                if k not in ("etype", "ts", "proc", "label")
+            }
+        print("# bench-detail:", json.dumps(extra))
+        reg.close()
+        return
 
     if args.serve_only:
         serve_bench_rows(emit, seed=args.serve_seed, **serve_cfg_kw)
@@ -813,6 +921,10 @@ def main(argv: list[str] | None = None) -> None:
     # Tracing substrate cost (ISSUE 7): host-side span-emission µs per
     # step, A/B traced vs untraced — PERF.md reads the % off this row.
     emit("trace_overhead", _safe("trace_overhead", trace_overhead_bench))
+    # Device-time attribution (ISSUE 8): component breakdown + overlap%
+    # for the b8 reference step, gated structurally (every dot attributed,
+    # unattributed share bounded) with the census cross-check.
+    emit("devprof_b8", _safe("devprof_b8", devprof_bench))
     emit("ring_block_smoke", _safe("ring_block_smoke", ring_block_smoke))
 
     # Assemble the detail line FROM the registry's event stream: each

@@ -230,6 +230,19 @@ class ObsConfig:
     # (segments events.r<k>.jsonl.1, .2, …; readers discover them).
     # 0 = never rotate (legacy single-file shard).
     rotate_mb: float = 0.0
+    # --- device-time observatory (dtc_tpu/obs/devprof.py, ISSUE 8) ---
+    # Programmatic device-profile capture windows: every N steps a
+    # devprof_steps-step jax.profiler trace lands under
+    # <obs dir>/devprof/step<k>_<reason>/ with a meta sidecar (wall-clock
+    # anchors + peak_hbm_bytes watermark). 0 = no cadence (windows still
+    # fire on demand / on trigger). Analyze offline with
+    # `scripts/trace_report.py <run> --device`.
+    devprof_every: int = 0
+    devprof_steps: int = 2
+    # Also capture on the PR 7 trigger points: first SLO breach and
+    # hung-step watchdog flag (one window per trigger, warn-and-disable
+    # on profiler failure — telemetry never kills the run).
+    devprof_on_trigger: bool = True
 
     def __post_init__(self) -> None:
         if self.memory_sample_every < 0:
@@ -242,6 +255,10 @@ class ObsConfig:
             raise ValueError("flight_recorder must be >= 0 (0 = off)")
         if self.rotate_mb < 0:
             raise ValueError("rotate_mb must be >= 0 (0 = no rotation)")
+        if self.devprof_every < 0:
+            raise ValueError("devprof_every must be >= 0 (0 = no cadence)")
+        if self.devprof_steps < 1:
+            raise ValueError("devprof_steps must be >= 1")
 
 
 @dataclass(frozen=True)

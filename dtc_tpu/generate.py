@@ -151,7 +151,11 @@ def _generate_impl(
     cache = init_cache(model, b)
 
     # Prefill: one forward over the whole prompt fills every layer's cache.
-    cache, logits = decode_step(model, params, cache, prompt)
+    # named_scope (ISSUE 8): the device-time attribution separates the
+    # prompt pass from the token scan by these scopes — the decode leg of
+    # the same provenance the train step's fwd/optimizer scopes provide.
+    with jax.named_scope("prefill"):
+        cache, logits = decode_step(model, params, cache, prompt)
     rng, sub = jax.random.split(rng)
     first = sample(logits[:, -1], sub)
 
@@ -173,7 +177,8 @@ def _generate_impl(
 
     if max_new_tokens == 1:
         return first[:, None]
-    _, rest = jax.lax.scan(body, init, None, length=max_new_tokens - 1)
+    with jax.named_scope("decode"):
+        _, rest = jax.lax.scan(body, init, None, length=max_new_tokens - 1)
     return jnp.concatenate([first[:, None], rest.T], axis=1)
 
 

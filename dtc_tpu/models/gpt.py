@@ -65,9 +65,16 @@ class CausalSelfAttention(nn.Module):
         def dense(name):
             return nn.Dense(cfg.d_model, name=name, dtype=cdtype, param_dtype=pdtype)
 
-        q = dense("q_proj")(x).reshape(b, t, cfg.n_heads, cfg.head_dim)
-        k = dense("k_proj")(x).reshape(b, t, cfg.n_heads, cfg.head_dim)
-        v = dense("v_proj")(x).reshape(b, t, cfg.n_heads, cfg.head_dim)
+        # named_scope component annotation (ISSUE 8): trace-time-only HLO
+        # op_name provenance so XLA fusions roll up to model components in
+        # the device-time attribution (obs/devprof.py). attn_qkv /
+        # attn_kernel / attn_proj split the attention block into its
+        # projection, kernel, and output legs — the same cut PERF.md's
+        # hand-read rounds used.
+        with jax.named_scope("attn_qkv"):
+            q = dense("q_proj")(x).reshape(b, t, cfg.n_heads, cfg.head_dim)
+            k = dense("k_proj")(x).reshape(b, t, cfg.n_heads, cfg.head_dim)
+            v = dense("v_proj")(x).reshape(b, t, cfg.n_heads, cfg.head_dim)
 
         if decode:
             # Autoregressive KV-cache path (inference; single device or
@@ -147,17 +154,19 @@ class CausalSelfAttention(nn.Module):
                 # packed cache, masked to the frontier. Multi-token calls
                 # (prefill — once per sequence) and unsupported cache
                 # lengths take the XLA oracle below.
-                out = fused.fused_decode_attention(
-                    q.reshape(b, 1, hd), ck.value, cv.value, idx,
-                    h=cfg.n_heads, d=cfg.head_dim,
-                ).reshape(b, 1, cfg.n_heads, cfg.head_dim)
+                with jax.named_scope("attn_kernel"):
+                    out = fused.fused_decode_attention(
+                        q.reshape(b, 1, hd), ck.value, cv.value, idx,
+                        h=cfg.n_heads, d=cfg.head_dim,
+                    ).reshape(b, 1, cfg.n_heads, cfg.head_dim)
             else:
-                out = decode_attention(
-                    q,
-                    ck.value.reshape(b, cfg.max_seq_len, cfg.n_heads, cfg.head_dim),
-                    cv.value.reshape(b, cfg.max_seq_len, cfg.n_heads, cfg.head_dim),
-                    idx,
-                )
+                with jax.named_scope("attn_kernel"):
+                    out = decode_attention(
+                        q,
+                        ck.value.reshape(b, cfg.max_seq_len, cfg.n_heads, cfg.head_dim),
+                        cv.value.reshape(b, cfg.max_seq_len, cfg.n_heads, cfg.head_dim),
+                        idx,
+                    )
         else:
             # Head axis is the TP-sharded axis: under TP each device holds
             # n_heads / model_parallelism heads and attention is
@@ -167,19 +176,21 @@ class CausalSelfAttention(nn.Module):
             k = nn.with_logical_constraint(k, ("batch", "seq", "heads", "head_dim"))
             v = nn.with_logical_constraint(v, ("batch", "seq", "heads", "head_dim"))
 
-            out = causal_attention(
-                q, k, v,
-                impl=cfg.attention,
-                block_q=cfg.attention_block_q,
-                block_kv=cfg.attention_block_kv,
-                block_q_bwd=cfg.attention_block_q_bwd,
-                block_kv_bwd=cfg.attention_block_kv_bwd,
-            )
-        out = out.reshape(b, t, cfg.d_model)
-        out = dense("out_proj")(out)
-        # Row-parallel output: constraining back to embed-replicated makes
-        # XLA insert the TP all-reduce here.
-        out = nn.with_logical_constraint(out, ("batch", "seq", "embed"))
+            with jax.named_scope("attn_kernel"):
+                out = causal_attention(
+                    q, k, v,
+                    impl=cfg.attention,
+                    block_q=cfg.attention_block_q,
+                    block_kv=cfg.attention_block_kv,
+                    block_q_bwd=cfg.attention_block_q_bwd,
+                    block_kv_bwd=cfg.attention_block_kv_bwd,
+                )
+        with jax.named_scope("attn_proj"):
+            out = out.reshape(b, t, cfg.d_model)
+            out = dense("out_proj")(out)
+            # Row-parallel output: constraining back to embed-replicated
+            # makes XLA insert the TP all-reduce here.
+            out = nn.with_logical_constraint(out, ("batch", "seq", "embed"))
         return out
 
 
@@ -191,11 +202,12 @@ class MLP(nn.Module):
         cfg = self.cfg
         cdtype = _dtype(cfg.compute_dtype)
         pdtype = _dtype(cfg.param_dtype)
-        h = nn.Dense(cfg.d_ff, name="fc1", dtype=cdtype, param_dtype=pdtype)(x)
-        h = nn.gelu(h)
-        h = nn.with_logical_constraint(h, ("batch", "seq", "mlp"))  # column-parallel
-        h = nn.Dense(cfg.d_model, name="fc2", dtype=cdtype, param_dtype=pdtype)(h)
-        h = nn.with_logical_constraint(h, ("batch", "seq", "embed"))  # row-parallel all-reduce
+        with jax.named_scope("mlp"):
+            h = nn.Dense(cfg.d_ff, name="fc1", dtype=cdtype, param_dtype=pdtype)(x)
+            h = nn.gelu(h)
+            h = nn.with_logical_constraint(h, ("batch", "seq", "mlp"))  # column-parallel
+            h = nn.Dense(cfg.d_model, name="fc2", dtype=cdtype, param_dtype=pdtype)(h)
+            h = nn.with_logical_constraint(h, ("batch", "seq", "embed"))  # row-parallel all-reduce
         return h
 
 

@@ -12,7 +12,24 @@ with straggler detection (serving-aware). See the README's
 """
 
 from dtc_tpu.obs.aggregate import find_shards, reduce_shards, shard_path
-from dtc_tpu.obs.device import max_stat, peak_hbm_bytes, sample_memory
+from dtc_tpu.obs.device import (
+    hbm_watermark,
+    max_stat,
+    peak_hbm_bytes,
+    sample_memory,
+)
+from dtc_tpu.obs.devprof import (
+    Attribution,
+    CaptureWindow,
+    DeviceProfiler,
+    OpRow,
+    analyze_capture,
+    attribute,
+    device_op_rows,
+    device_rows_to_events,
+    find_captures,
+    scope_map_from_hlo,
+)
 from dtc_tpu.obs.profiling import StepWindowProfiler
 from dtc_tpu.obs.registry import (
     CsvSink,
@@ -33,19 +50,29 @@ from dtc_tpu.obs.trace import (
 )
 
 __all__ = [
+    "Attribution",
+    "CaptureWindow",
     "CompileWatcher",
     "CsvSink",
+    "DeviceProfiler",
     "FlightRecorder",
     "JsonlSink",
     "MemorySink",
     "MetricsRegistry",
     "Objective",
+    "OpRow",
     "SloMonitor",
     "StepClock",
     "StepWindowProfiler",
     "Telemetry",
     "Tracer",
+    "analyze_capture",
+    "attribute",
+    "device_op_rows",
+    "device_rows_to_events",
+    "find_captures",
     "find_shards",
+    "hbm_watermark",
     "load_flight_dump",
     "max_stat",
     "peak_hbm_bytes",
@@ -53,6 +80,7 @@ __all__ = [
     "reduce_shards",
     "rotated_segments",
     "sample_memory",
+    "scope_map_from_hlo",
     "shard_path",
     "to_chrome_trace",
 ]

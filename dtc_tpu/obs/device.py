@@ -58,3 +58,16 @@ def max_stat(samples: list[dict[str, Any]] | None, key: str) -> int | None:
 def peak_hbm_bytes(samples: list[dict[str, Any]] | None) -> int | None:
     """Max ``peak_bytes_in_use`` across one ``sample_memory()`` result."""
     return max_stat(samples, "peak_bytes_in_use")
+
+
+def hbm_watermark() -> dict[str, int | None]:
+    """One-shot memory high-water snapshot for profile artifacts: each
+    devprof capture window records this at close (ISSUE 8), so the trace's
+    timing rows always travel with the HBM peak of the window they were
+    measured in. Explicit nulls on backends without accounting (CPU) —
+    "backend can't say", not "zero bytes"."""
+    samples = sample_memory()
+    return {
+        "peak_hbm_bytes": peak_hbm_bytes(samples),
+        "hbm_bytes_in_use": max_stat(samples, "bytes_in_use"),
+    }

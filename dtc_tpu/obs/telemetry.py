@@ -75,6 +75,7 @@ import time
 
 from dtc_tpu.obs.aggregate import reduce_shards, shard_path
 from dtc_tpu.obs.device import peak_hbm_bytes, sample_memory
+from dtc_tpu.obs.devprof import DeviceProfiler
 from dtc_tpu.obs.profiling import StepWindowProfiler
 from dtc_tpu.obs.registry import CsvSink, JsonlSink, MetricsRegistry
 from dtc_tpu.obs.slo import SloMonitor
@@ -143,6 +144,25 @@ class Telemetry:
             slo_cfg, self.registry, runtime="train"
         )
         self._slo_check_every = getattr(slo_cfg, "check_every", 8) or 8
+        # Device-time observatory (ISSUE 8): programmatic jax.profiler
+        # capture windows — cadence via obs.devprof_every, on-demand via
+        # request_device_profile(), plus the SLO-breach / hung-step
+        # triggers below when obs.devprof_on_trigger. Artifacts land under
+        # <obs dir>/devprof/ with meta sidecars; `trace_report.py --device`
+        # is the offline leg. Inert (no windows) until a cadence/trigger
+        # fires; warn-and-disable on profiler failure.
+        # Constructed whenever obs is on (inert until a cadence, trigger,
+        # or explicit request fires): gating on the knobs would silently
+        # kill the documented on-demand path for devprof_every=0 +
+        # devprof_on_trigger=false configs.
+        self.devprof: DeviceProfiler | None = None
+        if self.cfg.enabled and self._dump_dir:
+            self.devprof = DeviceProfiler(
+                os.path.join(self._dump_dir, "devprof"),
+                registry=self.registry,
+                every=self.cfg.devprof_every,
+                n_steps=self.cfg.devprof_steps,
+            )
         self.compiles.activate()
 
     # -- construction -----------------------------------------------------
@@ -205,6 +225,10 @@ class Telemetry:
 
     def on_step_start(self, step: int) -> None:
         self.profiler.step(step)
+        if self.devprof is not None:
+            # One jax profiler session per process: defer devprof windows
+            # while the legacy configured window is mid-capture.
+            self.devprof.on_step(step, busy=self.profiler._active)
         self.clock.begin(step)
 
     def on_step_end(self, step: int, *, elapsed_s: float, synced: bool) -> dict:
@@ -273,7 +297,21 @@ class Telemetry:
             self.slo.observe("step_time_s", breakdown["step_time_s"])
             self.slo.observe("data_wait_s", breakdown["data_wait_s"])
             if step % self._slo_check_every == 0:
-                self.slo.evaluate(step=step)
+                # evaluate() RETURNS every currently-breaching objective
+                # (level); only objectives newly entering the active set
+                # (edge) arm a capture — a persistently-breaching run must
+                # not re-capture every check until max_captures burns out.
+                prev_active = set(self.slo.active)
+                breaches = self.slo.evaluate(step=step)
+                fresh = [
+                    b for b in breaches if b["objective"] not in prev_active
+                ]
+                if fresh and self.devprof is not None and self.cfg.devprof_on_trigger:
+                    # PR 7 told you the SLO broke; PR 8 captures WHERE the
+                    # device time went while it was breaking.
+                    self.devprof.request(
+                        f"slo_breach:{fresh[0]['objective']}"
+                    )
         every = self.cfg.memory_sample_every
         if self.cfg.enabled and every > 0 and step % every == 0:
             self.sample_memory(step)
@@ -366,6 +404,11 @@ class Telemetry:
         path = os.path.join(
             self._dump_dir, f"flight.r{self.registry.process_index}.json"
         )
+        if self.devprof is not None and self.devprof.last_artifact:
+            # The newest device-profile capture rides every post-mortem:
+            # the dump names the trace artifact covering (or nearest to)
+            # the failure window.
+            meta.setdefault("devprof_artifact", self.devprof.last_artifact)
         try:
             return self.recorder.dump(path, reason=reason, **meta)
         except OSError as e:  # post-mortem aid must never kill the run
@@ -385,6 +428,8 @@ class Telemetry:
     def on_hung_step(self, step: int, **fields: Any) -> None:
         self.registry.counter("hung_steps").inc()
         self.registry.emit("hung_step", step=step, **fields)
+        if self.devprof is not None and self.cfg.devprof_on_trigger:
+            self.devprof.request("hung_step")
         self.dump_flight("hung_step", step=step)
 
     def drain_recovery_bus(self, bus: Any, step: int) -> None:
@@ -412,6 +457,31 @@ class Telemetry:
         p.start, p.stop = start_step, start_step + n_steps
         p.enabled = True
         return True
+
+    def request_device_profile(self, reason: str = "on_demand") -> bool:
+        """Arm an on-demand devprof capture window at the next step —
+        the programmatic replacement for hand-driving
+        ``scripts/profile_step.py`` against a live run. False when the
+        observatory is off, disabled, or already capturing/pending."""
+        if self.devprof is None:
+            return False
+        return self.devprof.request(reason)
+
+    def set_device_profile_context(
+        self,
+        *,
+        step_flops: float | None = None,
+        peak_flops: float | None = None,
+        comm_estimate: dict[str, float] | None = None,
+    ) -> None:
+        """Attach run context to future capture metas so the offline leg
+        (``trace_report.py --device``) can derive device-time MFU and run
+        the collective-census cross-check without rebuilding the model."""
+        if self.devprof is None:
+            return
+        self.devprof.step_flops = step_flops
+        self.devprof.peak_flops = peak_flops
+        self.devprof.comm_estimate = comm_estimate
 
     def sample_memory(self, step: int) -> None:
         samples = sample_memory()
@@ -476,5 +546,7 @@ class Telemetry:
             return
         self._closed = True
         self.profiler.close()
+        if self.devprof is not None:
+            self.devprof.close()  # finalize a window the run ended inside
         self.compiles.deactivate()
         self.registry.close()

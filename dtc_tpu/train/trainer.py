@@ -554,6 +554,28 @@ def _train(
             train_cfg, lead=lead, process_index=jax.process_index(),
             resumed=start_step > 0,
         )
+        # Device-profile context (ISSUE 8): capture metas carry the step's
+        # model FLOPs, the chip peak, and the static collective-census
+        # estimate, so `trace_report.py --device` derives device-time MFU
+        # and runs the census cross-check offline without the model.
+        from dtc_tpu.utils.metrics import (
+            gpt_step_flops, moe_step_flops, peak_flops_per_chip,
+        )
+
+        step_flops_fn = (
+            moe_step_flops if model_cfg.moe_experts > 0 else gpt_step_flops
+        )
+        tele.set_device_profile_context(
+            step_flops=step_flops_fn(
+                model_cfg, train_cfg.batch, model_cfg.max_seq_len
+            ),
+            peak_flops=peak_flops_per_chip(),
+            comm_estimate=comm_bytes_per_step(
+                model_cfg, train_cfg.batch, model_cfg.max_seq_len,
+                {k: int(v) for k, v in mesh.shape.items()},
+                train_cfg.parallel, train_cfg.pp_microbatches,
+            ),
+        )
         # From here to the training loop's own handler, any raise must
         # close the telemetry: a leaked sink would hold the JSONL shard
         # open (run_start unflushed) and leave the process-global compile

@@ -1,10 +1,25 @@
 """Back-compat shim: :class:`StepWindowProfiler` moved into the telemetry
 subsystem (``dtc_tpu/obs/profiling.py``), hardened to warn-and-disable on
 an already-active profiler session or an unwritable log dir instead of
-killing the run. Import from :mod:`dtc_tpu.obs` in new code."""
+killing the run. Import from :mod:`dtc_tpu.obs` in new code.
+
+Importing this module emits a one-time :class:`DeprecationWarning`
+(module objects are cached, so the warning fires once per process) —
+ISSUE 8 satellite: the README/config docs no longer reference this path,
+and a future PR can delete it once nothing trips the warning.
+"""
 
 from __future__ import annotations
 
+import warnings
+
 from dtc_tpu.obs.profiling import StepWindowProfiler
+
+warnings.warn(
+    "dtc_tpu.utils.profiling is deprecated; StepWindowProfiler lives in "
+    "dtc_tpu.obs.profiling (import from dtc_tpu.obs)",
+    DeprecationWarning,
+    stacklevel=2,
+)
 
 __all__ = ["StepWindowProfiler"]

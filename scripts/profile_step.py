@@ -13,9 +13,6 @@ the breakdown the decode roofline in PERF.md round 7 is checked against.
 from __future__ import annotations
 
 import argparse
-import glob
-import gzip
-import json
 import os
 import sys
 from collections import defaultdict
@@ -81,32 +78,29 @@ def run_decode(batch: int, trace_dir: str, prompt_len: int, new_tokens: int,
 
 
 def parse(trace_dir: str, steps: int, top: int):
-    paths = glob.glob(os.path.join(trace_dir, "**", "*.trace.json.gz"), recursive=True)
-    assert paths, f"no trace under {trace_dir}"
-    path = max(paths, key=os.path.getmtime)
-    with gzip.open(path, "rt") as f:
-        data = json.load(f)
-    events = data.get("traceEvents", [])
-    # Device-side complete events: pid whose name mentions TPU/device XLA ops.
+    """Per-fusion time table over the newest trace under ``trace_dir``.
+
+    Refactored onto the shared devprof parser (ISSUE 8) — the duplicated
+    trace-walking code this file carried is deleted; selection semantics
+    (device pids, umbrella-event skip) and the ``--top`` output format are
+    byte-identical on TPU traces, so the committed PERF.md rounds remain
+    reproducible. The parser's CPU fallback additionally gives this tool
+    rows on the CPU backend, where the old walker found no device pid and
+    printed an empty table.
+    """
+    from dtc_tpu.obs import devprof
+
+    path = devprof.find_trace_file(trace_dir)
+    assert path, f"no trace under {trace_dir}"
+    rows = devprof.device_op_rows(devprof.load_trace(path))
     by_name = defaultdict(float)
-    pids = {}
-    for e in events:
-        if e.get("ph") == "M" and e.get("name") == "process_name":
-            pids[e["pid"]] = e["args"].get("name", "")
-    dev_pids = {p for p, n in pids.items() if "TPU" in n or "/device" in n.lower()}
-    for e in events:
-        if e.get("ph") == "X" and e.get("pid") in dev_pids:
-            name = e.get("name", "")
-            # Skip umbrella events: jit_* module spans and bare step-number
-            # markers wrap the real op events and would double-count.
-            if name.startswith("jit_") or name.isdigit():
-                continue
-            by_name[name] += e.get("dur", 0) / 1e6  # us -> s
-    rows = sorted(by_name.items(), key=lambda kv: -kv[1])[:top]
+    for r in rows:
+        by_name[r.name] += r.dur_s
+    top_rows = sorted(by_name.items(), key=lambda kv: -kv[1])[:top]
     print(f"# trace: {path}")
     print("# NOTE: rows are NOT additive — while.N loop ops nest the ops")
     print("# executed inside them (e.g. attn.* kernels run within the scan).")
-    for name, dur in rows:
+    for name, dur in top_rows:
         print(f"{dur / steps * 1e3:8.3f} ms/step  {name[:110]}")
 
 

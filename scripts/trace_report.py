@@ -20,10 +20,21 @@ and answers the post-hoc questions the online monitor can't:
 - **--compare OTHER_RUN**: span-summary and histogram-percentile diff
   between two runs (the regression-hunting view).
 - **--flight**: pretty-print the newest flight-recorder dump.
+- **--device [CAPTURE_DIR]** (ISSUE 8): the device-side leg — parse the
+  newest devprof capture (``<run>/obs/devprof/step*/``, or an explicit
+  capture/trace dir), print the per-component device-time attribution
+  (embed/attn_qkv/attn_kernel/attn_proj/mlp|moe/ln/head/... shares,
+  fwd/bwd/optimizer phase split, comm/compute overlap, device-time MFU
+  when the meta carries FLOPs+peak), and — with ``--perfetto`` — merge
+  the device ops into the SAME export as the host spans on aligned
+  wall-clocks: one file, both timelines. ``--hlo FILE`` supplies
+  optimized-HLO text for scope recovery on backends whose trace events
+  carry bare instruction names (CPU).
 
     python scripts/trace_report.py outputs/run1 [--waterfall]
         [--slowest 15] [--perfetto /tmp/trace.json]
         [--compare outputs/run2] [--flight]
+        [--device [CAPTURE_DIR]] [--hlo HLO.txt]
 """
 
 from __future__ import annotations
@@ -36,6 +47,7 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from dtc_tpu.obs import devprof  # noqa: E402
 from dtc_tpu.obs.aggregate import find_shards  # noqa: E402
 from dtc_tpu.obs.registry import read_jsonl  # noqa: E402
 from dtc_tpu.obs.trace import _event_time, to_chrome_trace  # noqa: E402
@@ -247,7 +259,62 @@ def print_compare(rows: list[dict]) -> None:
 
 
 # ---------------------------------------------------------------------------
-# CLI
+# device leg (ISSUE 8)
+
+
+def resolve_capture_dir(run_dir: str, device_arg: str) -> str | None:
+    """The capture dir to analyze: an explicit path, or the newest
+    devprof artifact under the run's obs dir."""
+    if device_arg and device_arg != "newest":
+        return device_arg
+    roots = [os.path.join(run_dir, "obs", "devprof"),
+             os.path.join(run_dir, "devprof")]
+    try:
+        roots.insert(0, os.path.join(resolve_obs_dir(run_dir), "devprof"))
+    except SystemExit:
+        pass  # no JSONL shards: still check the conventional locations
+    for root in roots:
+        captures = devprof.find_captures(root)
+        if captures:
+            return captures[-1]
+    return None
+
+
+def print_device_report(analysis: dict) -> None:
+    att = analysis["attribution"]
+    meta = analysis["meta"]
+    steps = max(int(meta.get("steps") or 1), 1)
+    print(
+        f"\n# device capture: {analysis['trace_path']}"
+        f"\n# reason={meta.get('reason', '?')!r} steps={steps} "
+        f"ops={att.n_ops} device_time={att.total_s:.4f}s "
+        f"busy={att.busy_s:.4f}s"
+    )
+    if meta.get("peak_hbm_bytes") is not None:
+        print(f"# peak_hbm_bytes={meta['peak_hbm_bytes']}")
+    hdr = f"{'component':<18}{'ms/step':>12}{'share':>9}"
+    print(hdr)
+    print("-" * len(hdr))
+    for r in att.component_table(steps=steps):
+        print(
+            f"{r['component']:<18}{r['s_per_step'] * 1e3:>12.3f}"
+            f"{r['share']:>9.1%}"
+        )
+    if att.phases:
+        phases = ", ".join(
+            f"{k}={v / steps * 1e3:.3f}ms" for k, v in sorted(att.phases.items())
+        )
+        print(f"# phases/step: {phases}")
+    print(
+        f"# collective={att.collective_s / steps * 1e3:.3f}ms/step "
+        f"overlap_ratio={att.overlap_ratio:.1%} "
+        f"unattributed={1 - att.attributed_share:.1%}"
+    )
+    u = att.device_mfu(meta.get("step_flops"), meta.get("peak_flops"), steps)
+    if u is not None:
+        print(f"# device-time MFU: {u:.4f}")
+    for w in devprof.census_crosscheck(att, meta.get("comm_estimate")):
+        print(f"# CENSUS WARNING: {w}")
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -263,9 +330,26 @@ def main(argv: list[str] | None = None) -> int:
                     help="diff span/percentile summaries against a second run")
     ap.add_argument("--flight", action="store_true",
                     help="print the newest flight-recorder dump")
+    ap.add_argument("--device", nargs="?", const="newest", default="",
+                    metavar="CAPTURE_DIR",
+                    help="device-time attribution from the newest devprof "
+                         "capture (or an explicit capture/trace dir); with "
+                         "--perfetto the device ops merge into the export")
+    ap.add_argument("--hlo", default="", metavar="HLO.txt",
+                    help="optimized-HLO text for scope recovery when the "
+                         "trace events carry bare instruction names (CPU)")
     args = ap.parse_args(argv)
 
-    events = load_events(args.run_dir)
+    try:
+        events = load_events(args.run_dir)
+    except SystemExit:
+        if not args.device:
+            raise
+        # A device capture can exist without a JSONL shard (obs.jsonl off,
+        # or an explicit capture dir): the device leg still reports; the
+        # merged export then carries the device track alone.
+        events = []
+        print(f"# no host event shards under {args.run_dir} (device leg only)")
     n_spans = len(spans_of(events))
     procs = sorted({e.get("proc", 0) for e in events})
     print(
@@ -298,12 +382,40 @@ def main(argv: list[str] | None = None) -> int:
     print_span_table(span_table(events), top=args.slowest)
     if args.waterfall:
         print_waterfalls(events)
+
+    device_events: list[dict] = []
+    if args.device:
+        cap = resolve_capture_dir(args.run_dir, args.device)
+        if cap is None:
+            print(
+                "# no devprof capture under this run (obs.devprof_every=0 "
+                "and no trigger fired?) — pass an explicit dir to --device"
+            )
+        else:
+            hlo_text = None
+            if args.hlo:
+                with open(args.hlo) as f:
+                    hlo_text = f.read()
+            analysis = devprof.analyze_capture(cap, hlo_text=hlo_text)
+            if analysis is None:
+                print(f"# capture {cap} holds no trace file (capture failed?)")
+            else:
+                print_device_report(analysis)
+                # Wall-aligned device spans for the merged export below:
+                # host spans and device ops land in ONE Perfetto file on
+                # one clock (the capture's t_wall_start anchor).
+                device_events = devprof.device_rows_to_events(
+                    analysis["rows"], anchor=analysis["anchor"],
+                    scope_map=analysis["scope_map"],
+                )
+
     if args.perfetto:
-        trace = to_chrome_trace(events)
+        trace = to_chrome_trace(events + device_events)
         with open(args.perfetto, "w") as f:
             json.dump(trace, f)
+        merged = f" (+{len(device_events)} device ops)" if device_events else ""
         print(
-            f"# wrote {len(trace['traceEvents'])} trace events to "
+            f"# wrote {len(trace['traceEvents'])} trace events{merged} to "
             f"{args.perfetto} (open in https://ui.perfetto.dev)"
         )
     return 0

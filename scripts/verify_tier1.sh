@@ -13,8 +13,8 @@
 timeout -k 10 120 env JAX_PLATFORMS=cpu python -m pytest --collect-only -q -p no:cacheprovider \
   tests/test_moe.py tests/test_collectives_hlo.py \
   tests/test_generate.py tests/test_metrics.py tests/test_analysis.py \
-  tests/test_serve.py tests/test_trace.py > /dev/null || {
-    echo "tier-1 pre-gate: MoE/HLO/decode/analysis/serve/trace test collection failed" >&2; exit 1; }
+  tests/test_serve.py tests/test_trace.py tests/test_devprof.py > /dev/null || {
+    echo "tier-1 pre-gate: MoE/HLO/decode/analysis/serve/trace/devprof test collection failed" >&2; exit 1; }
 # Pre-gate 2 (ISSUE 5 + 6): the graph audit — lower/compile the
 # dp/tp/fsdp/ep train steps (8-virtual-device CPU mesh), the greedy decode
 # scan, AND the serving (continuous-batching) decode step; run the rule
@@ -45,4 +45,15 @@ timeout -k 10 240 env JAX_PLATFORMS=cpu python scripts/serve_smoke.py || {
 # timestamps. ~1-2 min; catches a broken span/export pipeline early.
 timeout -k 10 300 env JAX_PLATFORMS=cpu python scripts/trace_smoke.py || {
     echo "tier-1 pre-gate: tracing smoke failed" >&2; exit 1; }
+# Pre-gate 5 (ISSUE 8): device-time observatory smoke — capture a 2-step
+# devprof window around the b8 audit train step (DEFAULT CPU thunk
+# runtime: the per-op trace events only exist there, which is why this
+# is a standalone script and not a pytest), then the offline leg: the
+# shared parser + attribution must cover >= 90% of measured device time
+# with every dot-class op attributed, and the merged host+device
+# Perfetto export must hold both timelines on aligned wall clocks.
+# Skips (exit 0) with a warning in environments whose profiler emits no
+# op events at all. ~1-2 min.
+timeout -k 10 300 env JAX_PLATFORMS=cpu python scripts/devprof_smoke.py || {
+    echo "tier-1 pre-gate: devprof smoke failed" >&2; exit 1; }
 set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc
