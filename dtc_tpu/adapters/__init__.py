@@ -1,0 +1,48 @@
+"""Multi-tenant LoRA adapters: finetune -> eval -> serve on one resident
+base model (ROADMAP item 5 — the scenario-diversity tentpole that
+compounds with the PR 6 serving runtime).
+
+- :mod:`~dtc_tpu.adapters.lora` — the injection pass over GPT's dense
+  layers (separate "lora" flax collection, base frozen; rank 0 = bitwise
+  off), the stacked ``(n_adapters, ...)`` serving buffers with per-slot
+  gathers, the offline merge oracle, and the adapter artifact io;
+- :mod:`~dtc_tpu.adapters.store` — host-side LRU + refcounted registry
+  over the resident stack slots (slot 0 pinned to base);
+- :mod:`~dtc_tpu.adapters.finetune` — the finetune -> eval-loss-gate ->
+  export leg, driven through the unchanged production trainer so
+  checkpoints/resilience operate on the adapter subtree only.
+
+See README "Multi-tenant adapters".
+"""
+
+from dtc_tpu.adapters.finetune import FinetuneOutcome, finetune_adapter
+from dtc_tpu.adapters.lora import (
+    apply_lora,
+    gather_slot_lora,
+    init_lora,
+    init_lora_stack,
+    load_adapter_file,
+    lora_enabled,
+    lora_shapes,
+    merge_lora,
+    save_adapter,
+    validate_lora_tree,
+)
+from dtc_tpu.adapters.store import BASE_SLOT, AdapterStore
+
+__all__ = [
+    "AdapterStore",
+    "BASE_SLOT",
+    "FinetuneOutcome",
+    "apply_lora",
+    "finetune_adapter",
+    "gather_slot_lora",
+    "init_lora",
+    "init_lora_stack",
+    "load_adapter_file",
+    "lora_enabled",
+    "lora_shapes",
+    "merge_lora",
+    "save_adapter",
+    "validate_lora_tree",
+]

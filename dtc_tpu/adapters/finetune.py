@@ -1,0 +1,98 @@
+"""The finetune -> eval-gate -> export leg of the adapter loop.
+
+One function, :func:`finetune_adapter`, drives a LoRA finetune through
+the UNCHANGED production trainer (``dtc_tpu.train.trainer.train``): the
+adapter subtree is the TrainState, so optimizer state, sha256-verified
+checkpoints, stream sidecars, SIGTERM graceful stop, and chaos rollback
+all come for free (the chaos acceptance test in tests/test_adapters.py
+proves a fault-riddled finetune bit-identical to a clean one, same as
+PR 2 proved for full training). The eval-loss gate then decides whether
+the adapter may ship: a finetune that made held-out loss worse than the
+base model's must not reach the serving engine.
+
+CLI wrapper: ``scripts/finetune_adapter.py``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+from dtc_tpu.config.schema import ModelConfig, OptimConfig, TrainConfig
+
+PyTree = Any
+
+
+@dataclasses.dataclass
+class FinetuneOutcome:
+    adapter: PyTree            # the trained "lora" subtree
+    base_params: PyTree        # the frozen base the adapter was trained on
+    eval_first: float | None   # eval loss at the first eval point (B=0: base)
+    eval_final: float | None
+    gate_passed: bool
+    losses: list               # training losses (the trainer's list)
+
+    def meta(self, model_cfg: ModelConfig, train_cfg: TrainConfig) -> dict:
+        a = model_cfg.adapter
+        return {
+            "rank": a.rank,
+            "alpha": a.alpha,
+            "dropout": a.dropout,
+            "target_modules": list(a.target_modules),
+            "d_model": model_cfg.d_model,
+            "n_layers": model_cfg.n_layers,
+            "d_ff": model_cfg.d_ff,
+            "steps": train_cfg.steps,
+            "seed": train_cfg.seed,
+            "eval_first": self.eval_first,
+            "eval_final": self.eval_final,
+            "gate_passed": self.gate_passed,
+        }
+
+
+def finetune_adapter(
+    train_cfg: TrainConfig,
+    model_cfg: ModelConfig,
+    opt_cfg: OptimConfig,
+    *,
+    gate_ratio: float = 1.0,
+) -> FinetuneOutcome:
+    """Finetune the adapter subtree and judge it by held-out eval loss.
+
+    The gate: ``eval_final <= gate_ratio * eval_first``, where
+    ``eval_first`` is the FIRST eval checkpoint — taken ``eval_every``
+    steps in (the trainer evaluates at ``step % eval_every == 0``), NOT
+    an exact step-0 base-model eval. With LoRA's zero-initialized B the
+    adapter starts AT the base model, so a small ``eval_every`` keeps the
+    anchor close to the base loss — but an aggressive lr can degrade
+    held-out loss within that first window and the gate would not see
+    it; keep ``eval_every`` small relative to ``steps`` (the shipped
+    config evaluates 3x over 60 steps). With ``eval_every == 0`` the
+    gate is vacuous (no eval points) and ``gate_passed`` is False — the
+    CLI refuses to export ungated adapters unless ``--no-gate``.
+    """
+    if model_cfg.adapter.rank <= 0:
+        raise ValueError(
+            "finetune_adapter needs an adapter-enabled model "
+            "(ModelConfig.adapter.rank > 0)"
+        )
+    from dtc_tpu.train.trainer import train
+
+    result = train(train_cfg, model_cfg, opt_cfg)
+    if result.base_params is None:  # pragma: no cover — trainer guarantees it
+        raise RuntimeError("adapter run returned no base params")
+    evals = sorted(result.eval_losses)
+    first = evals[0][1] if evals else None
+    final = evals[-1][1] if evals else None
+    passed = bool(
+        evals and final is not None and first is not None
+        and final <= gate_ratio * first + 1e-9
+    )
+    return FinetuneOutcome(
+        adapter=result.state.params,
+        base_params=result.base_params,
+        eval_first=first,
+        eval_final=final,
+        gate_passed=passed,
+        losses=list(result.losses),
+    )

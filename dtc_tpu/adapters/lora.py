@@ -1,0 +1,280 @@
+"""LoRA low-rank adapters over the frozen GPT base (Hu et al., 2021).
+
+The injection is a *collection split*, not a model fork: every targeted
+dense layer (``AdapterConfig.target_modules`` — the attention q/k/v/out
+projections and the dense-MLP fc1/fc2) computes
+
+    y = W x + b + (alpha/rank) * B (A x)
+
+with the base ``W``/``b`` untouched in the "params" collection and the
+low-rank ``A``/``B`` factors in a SEPARATE "lora" collection created on
+the owning module's scope (``<site>_a`` / ``<site>_b``). Consequences the
+rest of the repo builds on:
+
+- **rank 0 is bitwise off**: no variables are created, no ops are traced —
+  the compiled program is byte-identical to a pre-adapter model.
+- **B initializes to zero**, so a freshly-injected model equals the base
+  model exactly (finetuning starts from the base's loss).
+- **The trainer sees only the subtree**: optimizer state, sha256-verified
+  checkpoints, stream sidecars, and chaos rollback all operate on the
+  "lora" collection alone (``trainer.init_adapter_state``); the frozen
+  base params are a non-donated, non-differentiated step input.
+- **Serving is batched per-slot**: the factors support a leading batch
+  axis — ``A`` of shape ``(B, in, rank)`` applies row ``b``'s adapter to
+  batch row ``b`` — so the engine keeps ONE resident
+  ``(n_adapters, ...)`` stacked buffer and gathers per-slot factors
+  inside the jitted decode step (:func:`gather_slot_lora`). Admitting a
+  new tenant changes VALUES, never shapes: no recompile (audited,
+  ``serve_decode`` baseline).
+
+Under the layer scan the factors stack like every other block variable
+(leading "layers" axis; the scan's ``variable_axes`` carries "lora"), so
+a per-site training factor is ``(L, in, rank)`` and a gathered serving
+factor ``(L, B, in, rank)``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+
+PyTree = Any
+
+_DTYPES = {"float32": jnp.float32, "bfloat16": jnp.bfloat16, "float16": jnp.float16}
+
+
+def lora_enabled(cfg) -> bool:
+    """True when ``cfg`` (a ModelConfig) carries an active adapter block."""
+    acfg = getattr(cfg, "adapter", None)
+    return acfg is not None and acfg.rank > 0
+
+
+def apply_lora(mdl: nn.Module, base: nn.Module, x: jax.Array, *, cfg, name: str,
+               train: bool) -> jax.Array:
+    """Apply ``base`` (an ``nn.Dense``) and, when ``name`` is a targeted
+    adapter site, add the low-rank delta from the "lora" collection.
+
+    Called inside the owning module's ``@nn.compact`` body, so the factors
+    land on that module's scope (``attn/q_proj_a`` …) and the base param
+    tree is untouched — checkpoints, sharding rules, and the rank-0 graph
+    stay byte-compatible. The delta branches on the STATIC rank of the
+    stored factor: 2-D ``(in, r)`` is one shared adapter (training /
+    whole-batch decode), 3-D ``(B, in, r)`` is the serving engine's
+    per-slot gathered stack — same model, both flavors.
+    """
+    y = base(x)
+    acfg = getattr(cfg, "adapter", None)
+    if acfg is None or acfg.rank <= 0 or name not in tuple(acfg.target_modules):
+        return y
+    if not mdl.is_initializing() and not mdl.has_variable("lora", f"{name}_a"):
+        # Applying an adapter-enabled model WITHOUT a "lora" collection is
+        # the base model by definition (zero factors => zero delta), so
+        # skip the delta entirely instead of demanding a tree of zeros —
+        # generate()/eval on the bare base params just works.
+        return y
+    pdtype = _DTYPES[cfg.param_dtype]
+    cdtype = _DTYPES[cfg.compute_dtype]
+    in_f, out_f, r = x.shape[-1], y.shape[-1], acfg.rank
+
+    def init_a():
+        return nn.initializers.lecun_normal()(
+            mdl.make_rng("params"), (in_f, r), pdtype
+        )
+
+    def init_b():
+        # Zero B => zero delta at init: the injected model IS the base
+        # model until the first optimizer step (standard LoRA init).
+        return jnp.zeros((r, out_f), pdtype)
+
+    a = mdl.variable("lora", f"{name}_a", init_a)
+    b = mdl.variable("lora", f"{name}_b", init_b)
+    h = x
+    if train and acfg.dropout > 0.0:
+        h = nn.Dropout(
+            acfg.dropout, deterministic=False, name=f"{name}_lora_drop"
+        )(h)
+    hc = h.astype(cdtype)
+    av = a.value.astype(cdtype)
+    bv = b.value.astype(cdtype)
+    if av.ndim == 2:
+        delta = (hc @ av) @ bv
+    else:
+        # Per-row factors (B, in, r)/(B, r, out): row b of the activation
+        # sees row b's adapter — the batched multi-tenant decode path.
+        z = jnp.einsum("b...i,bir->b...r", hc, av)
+        delta = jnp.einsum("b...r,bro->b...o", z, bv)
+    return y + (acfg.scale * delta).astype(y.dtype)
+
+
+# ---------------------------------------------------------------------------
+# stacked serving buffers
+# ---------------------------------------------------------------------------
+
+def lora_shapes(model) -> PyTree | None:
+    """ShapeDtypeStructs of the model's "lora" collection (None when the
+    model has no adapters). ``jax.eval_shape`` over init — no params are
+    materialized and nothing runs (same trick as ``generate.init_cache``)."""
+    dummy = jnp.ones((1, 1), dtype=jnp.int32)
+    shapes = jax.eval_shape(
+        lambda: model.init(
+            {"params": jax.random.PRNGKey(0)}, dummy, train=False
+        )
+    )
+    return shapes.get("lora")
+
+
+def init_lora_stack(model, n_adapters: int) -> PyTree:
+    """The resident serving buffer: every lora leaf with a leading
+    ``(n_adapters,)`` axis, all zeros. Slot 0 stays all-zero forever —
+    zero factors make the delta exactly zero, so index 0 IS the base
+    model (un-adapted requests ride the same compiled step)."""
+    shapes = lora_shapes(model)
+    if shapes is None:
+        raise ValueError(
+            "model has no 'lora' collection (adapter.rank == 0) — an "
+            "adapter stack cannot be built for it"
+        )
+    return jax.tree.map(
+        lambda s: jnp.zeros((n_adapters,) + s.shape, s.dtype), shapes
+    )
+
+
+def gather_slot_lora(stack: PyTree, ids: jax.Array) -> PyTree:
+    """Per-slot factors from the resident stack: ``(n_adapters, L, ...)``
+    leaves gathered by ``ids`` (B,) then transposed to ``(L, B, ...)`` so
+    the layer scan (which splits axis 0) hands each layer its ``(B, ...)``
+    per-row factors. ``ids`` is traced — a fixed ``(B,)`` shape means
+    tenant churn never changes the compiled step."""
+    return jax.tree.map(lambda s: jnp.moveaxis(s[ids], 0, 1), stack)
+
+
+def validate_lora_tree(stack: PyTree, factors: PyTree) -> None:
+    """Raise ValueError unless ``factors`` matches the stack's per-adapter
+    structure and shapes (leaf shape == stack leaf shape minus the leading
+    adapter axis)."""
+    s_leaves, s_def = jax.tree.flatten(stack)
+    f_leaves, f_def = jax.tree.flatten(factors)
+    if s_def != f_def:
+        raise ValueError(
+            f"adapter factors tree structure {f_def} does not match the "
+            f"model's lora collection {s_def}"
+        )
+    for s, f in zip(s_leaves, f_leaves):
+        if tuple(s.shape[1:]) != tuple(jnp.shape(f)):
+            raise ValueError(
+                f"adapter factor shape {tuple(jnp.shape(f))} does not match "
+                f"the model's lora leaf shape {tuple(s.shape[1:])} (wrong "
+                "rank or model dims?)"
+            )
+
+
+# ---------------------------------------------------------------------------
+# offline merge oracle
+# ---------------------------------------------------------------------------
+
+def merge_lora(params: PyTree, lora: PyTree, cfg) -> PyTree:
+    """Fold the adapter into the base weights OFFLINE:
+    ``W' = W + (alpha/rank) * A @ B`` per targeted site.
+
+    The tests' numerics oracle: a plain (rank-0) model applied with the
+    merged params must decode token-identically to the runtime adapter
+    path (base matmul + low-rank delta). Handles the scan-stacked leading
+    "layers" axis via a batched contraction. Returns a new params tree;
+    inputs untouched."""
+    acfg = cfg.adapter
+    scale = acfg.scale
+
+    def merge_node(pnode: Any, lnode: Any) -> Any:
+        if not isinstance(lnode, dict):
+            return pnode
+        out = dict(pnode)
+        for key, sub in lnode.items():
+            if isinstance(sub, dict):
+                out[key] = merge_node(pnode[key], sub)
+            elif key.endswith("_a"):
+                site = key[: -len("_a")]
+                a, b = lnode[key], lnode[site + "_b"]
+                kernel = pnode[site]["kernel"]
+                delta = scale * jnp.einsum("...ir,...ro->...io", a, b)
+                out[site] = dict(
+                    pnode[site], kernel=(kernel + delta).astype(kernel.dtype)
+                )
+        return out
+
+    return merge_node(params, lora)
+
+
+# ---------------------------------------------------------------------------
+# adapter artifact io (the finetune -> serve handoff)
+# ---------------------------------------------------------------------------
+
+def _flatten_lora(lora: PyTree) -> dict[str, np.ndarray]:
+    flat = {}
+    for path, leaf in jax.tree_util.tree_flatten_with_path(lora)[0]:
+        key = "/".join(
+            str(getattr(k, "key", getattr(k, "idx", k))) for k in path
+        )
+        flat[key] = np.asarray(leaf)
+    return flat
+
+
+def save_adapter(path: str, lora: PyTree, meta: dict) -> None:
+    """One adapter artifact: flattened lora leaves + a JSON meta record
+    (rank/alpha/targets/provenance) in a single ``.npz``. Atomic
+    (tmp + os.replace), same contract as the checkpoint sidecars."""
+    flat = _flatten_lora(lora)
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        np.savez(f, __meta__=json.dumps(meta), **flat)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def load_adapter_file(path: str, like: PyTree | None = None):
+    """Load an adapter artifact -> ``(lora_tree, meta)``.
+
+    With ``like`` (the model's lora shape tree or a stack), the flat keys
+    are unflattened into that exact structure; without it a nested dict is
+    rebuilt from the ``/``-joined keys."""
+    with np.load(path, allow_pickle=False) as z:
+        meta = json.loads(str(z["__meta__"]))
+        flat = {k: z[k] for k in z.files if k != "__meta__"}
+    if like is not None:
+        paths = [
+            "/".join(str(getattr(k, "key", getattr(k, "idx", k))) for k in p)
+            for p, _ in jax.tree_util.tree_flatten_with_path(like)[0]
+        ]
+        missing = [p for p in paths if p not in flat]
+        if missing:
+            raise ValueError(f"adapter file {path} missing leaves {missing}")
+        treedef = jax.tree.structure(like)
+        return jax.tree.unflatten(
+            treedef, [jnp.asarray(flat[p]) for p in paths]
+        ), meta
+    tree: dict = {}
+    for key, leaf in flat.items():
+        node = tree
+        parts = key.split("/")
+        for part in parts[:-1]:
+            node = node.setdefault(part, {})
+        node[parts[-1]] = jnp.asarray(leaf)
+    return tree, meta
+
+
+def init_lora(model, seed: int = 0) -> PyTree:
+    """A freshly-initialized lora tree for ``model`` (A random, B zero) —
+    the finetune starting point and a convenient factor donor in tests."""
+    dummy = jnp.ones((1, 1), dtype=jnp.int32)
+    variables = jax.jit(
+        lambda r: model.init({"params": r}, dummy, train=False)
+    )(jax.random.PRNGKey(seed))
+    if "lora" not in variables:
+        raise ValueError("model has no 'lora' collection (adapter.rank == 0)")
+    return variables["lora"]

@@ -372,23 +372,38 @@ def build_decode_artifact(*, execute: bool = True) -> Artifact:
     )
 
 
-def build_serve_artifact(*, execute: bool = True) -> Artifact:
+def build_serve_artifact(*, execute: bool = True, lora: bool = True) -> Artifact:
     """Lower + compile the SERVING decode step — the continuous-batching
     iteration ``dtc_tpu/serve/engine.py`` drives over its fixed slot batch
     (per-slot ``(B,)`` cache frontiers, greedy argmax, finite flag).
 
-    The recompile fingerprint is the serving runtime's core compiled-shape
-    invariant: between the two measured step executions a request is
-    ADMITTED into a slot (prefill + jitted cache-surgery insert, both
-    pre-warmed so only the audited step is counted) and the batch goes
-    from one active slot to two — admitting/evicting requests at fixed
+    TWO audited flavors, because the engine builds two distinct compiled
+    step programs (``_build_fns`` branches on the model's adapter config):
+
+    - ``lora=True`` -> ``serve_decode``: the MULTI-TENANT flavor (ISSUE
+      10) — the audit model carries a rank-2 LoRA adapter config, so the
+      audited program includes the per-slot factor gather from the
+      resident ``(max_adapters, ...)`` stack. Its recompile fingerprint
+      extends the compiled-shape invariant across the adapter lifecycle:
+      between the two measured step executions an adapter is LOADED
+      (jitted traced-slot stack write, pre-warmed) and a request ADMITTED
+      — the batch goes from one tenant slot to a mixed adapter+base
+      batch of two.
+    - ``lora=False`` -> ``serve_decode_base``: the adapter-free flavor
+      every plain deployment runs — baselined separately so a regression
+      in THAT branch cannot hide behind a green lora audit.
+
+    Either way: admission, eviction, and (lora) tenant churn at fixed
     slots must reuse the ONE executable (cold==1, steady==0), or serving
-    latency grows a compile stall on every arrival."""
-    from dtc_tpu.config.schema import ServeConfig
+    latency grows a compile stall on every arrival/load."""
+    from dtc_tpu.config.schema import AdapterConfig, ServeConfig
     from dtc_tpu.serve.engine import ServingEngine
     from dtc_tpu.serve.request import Request
 
-    model_cfg = audit_model_cfg()
+    overrides = (
+        dict(adapter=AdapterConfig(rank=2, alpha=4.0)) if lora else {}
+    )
+    model_cfg = audit_model_cfg(**overrides)
     model = GPT(model_cfg)
     params = jax.jit(
         lambda r, x: model.init({"params": r, "dropout": r}, x, train=False)
@@ -396,10 +411,16 @@ def build_serve_artifact(*, execute: bool = True) -> Artifact:
         "params"
     ]
     scfg = ServeConfig(slots=2, page_size=8, queue_depth=8, max_new_tokens=4,
-                       prefill_bucket=8)
+                       prefill_bucket=8, max_adapters=4)
     eng = ServingEngine(model, params, scfg)
     toks = jnp.zeros((scfg.slots,), jnp.int32)
-    args = (params, eng.cache, toks)
+    if lora:
+        args = (
+            params, eng.lora_stack, jnp.asarray(eng.slot_adapter),
+            eng.cache, toks,
+        )
+    else:
+        args = (params, eng.cache, toks)
     lowered = eng._step_fn.lower(*args)
     stablehlo = lowered.as_text()
     hlo = lowered.compile().as_text()
@@ -410,24 +431,42 @@ def build_serve_artifact(*, execute: bool = True) -> Artifact:
     )
     cold = steady = None
     if execute:
-        # Warm every helper an admission runs (prefill/insert/fingerprint)
-        # so the measured window isolates the decode step itself.
-        eng.submit(Request(rid="warm", prompt=[1, 2, 3], max_new_tokens=1))
+        # Warm every helper an admission (and, lora flavor, an adapter
+        # load) runs — prefill / cache insert / stack insert — so the
+        # measured window isolates the decode step itself. Factors built
+        # up front: init_lora jits its own one-off init, which must not
+        # land inside the window.
+        warm_ad = None
+        if lora:
+            from dtc_tpu.adapters import init_lora
+
+            factors = init_lora(model, seed=1)
+            eng.load_adapter("warm_ad", factors)
+            warm_ad = "warm_ad"
+        eng.submit(Request(rid="warm", prompt=[1, 2, 3], max_new_tokens=1,
+                           adapter=warm_ad))
         eng.run(max_steps=8)
 
         def call_once():
-            eng.submit(Request(rid="a", prompt=[1, 2, 3], max_new_tokens=4))
+            ad = None
+            if lora:
+                eng.load_adapter("t1", factors)  # traced-slot stack write
+                ad = "t1"
+            eng.submit(Request(rid="a", prompt=[1, 2, 3], max_new_tokens=4,
+                               adapter=ad))
             eng.step()  # admits "a", decodes — the step's ONE compile
             return eng.cache
 
         def call_again(_):
+            if lora:
+                eng.load_adapter("t2", factors)  # hot load mid-flight
             eng.submit(Request(rid="b", prompt=[4, 5], max_new_tokens=4))
-            eng.step()  # admits "b" mid-flight: same executable, batch 1->2
+            eng.step()  # admits base "b": batch 1->2 (mixed when lora)
             return eng.cache
 
         cold, steady = _measure_compiles(call_once, call_again)
     return Artifact(
-        name="serve_decode",
+        name="serve_decode" if lora else "serve_decode_base",
         kind="serve",
         parallel=None,
         mesh_shape={},
@@ -457,5 +496,9 @@ def build_artifacts(
     if decode:
         arts.append(build_decode_artifact(execute=execute))
     if serve:
-        arts.append(build_serve_artifact(execute=execute))
+        # Both serving flavors: the multi-tenant (lora) step AND the
+        # adapter-free step — distinct compiled programs, each with its
+        # own committed baseline.
+        arts.append(build_serve_artifact(execute=execute, lora=True))
+        arts.append(build_serve_artifact(execute=execute, lora=False))
     return arts

@@ -87,6 +87,41 @@ def load_serve_config(
     return serve_cfg, model_cfg
 
 
+def load_finetune_config(
+    finetune_config_path: str | Path,
+    model_config_path: str | Path | None = None,
+    optim_config_path: str | Path | None = None,
+    model_overrides: dict[str, Any] | None = None,
+):
+    """Load the (train, model, optim) triple for a LoRA finetune run
+    (``scripts/finetune_adapter.py``).
+
+    The finetune YAML is a TrainConfig file PLUS one extra top-level
+    ``adapter:`` block (rank/alpha/dropout/target_modules — see
+    ``configs/finetune_lora.yaml``), which is lifted onto the MODEL config
+    where AdapterConfig lives. Model/optim paths default to siblings, same
+    convention as :func:`load_config`."""
+    from dtc_tpu.config.schema import ModelConfig, OptimConfig, TrainConfig
+
+    finetune_config_path = Path(finetune_config_path)
+    cfg_dir = finetune_config_path.parent
+    model_config_path = Path(model_config_path or cfg_dir / "model_config.yaml")
+    optim_config_path = Path(optim_config_path or cfg_dir / "optim_config.yaml")
+
+    with open(finetune_config_path) as f:
+        raw = yaml.safe_load(f) or {}
+    adapter = raw.pop("adapter", None)
+    train_cfg = _build(TrainConfig, raw, str(finetune_config_path))
+    overrides = dict(model_overrides or {})
+    if adapter is not None and "adapter" not in overrides:
+        overrides["adapter"] = adapter
+    model_cfg = load_yaml_dataclass(
+        model_config_path, ModelConfig, overrides=overrides
+    )
+    optim_cfg = load_yaml_dataclass(optim_config_path, OptimConfig)
+    return train_cfg, model_cfg, optim_cfg
+
+
 def load_config(
     train_config_path: str | Path,
     model_config_path: str | Path | None = None,

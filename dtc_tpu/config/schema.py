@@ -18,6 +18,65 @@ PyTree = Any
 
 VALID_PARALLEL = ("none", "dp", "tp", "pp", "3d", "fsdp")
 
+#: Dense layers the LoRA injection pass can target (dtc_tpu/adapters/):
+#: the attention projections and the dense-MLP matmuls. The MoE expert
+#: tensors are not injectable (no per-expert adapters yet); with
+#: ``moe_experts > 0`` the fc1/fc2 targets simply never exist.
+ADAPTER_TARGETS = ("q_proj", "k_proj", "v_proj", "out_proj", "fc1", "fc2")
+
+
+@dataclass(frozen=True)
+class AdapterConfig:
+    """LoRA adapter knobs (Hu et al., 2021 — ``dtc_tpu/adapters/``).
+
+    ``rank == 0`` (the default) disables injection ENTIRELY: no "lora"
+    collection is created and the compiled programs are byte-identical to
+    a pre-adapter model (asserted bitwise in tests/test_adapters.py).
+    With ``rank > 0`` every targeted dense layer gains frozen-base +
+    low-rank delta semantics: ``y = W x + (alpha/rank) * B (A x)`` with
+    A/B living in a SEPARATE flax collection ("lora"), so the trainer's
+    optimizer state, checkpoints, and chaos recovery operate on the tiny
+    adapter subtree only, and the serving engine can stack many tenants'
+    factors into one resident ``(n_adapters, ...)`` buffer.
+    """
+
+    rank: int = 0              # low-rank dimension; 0 = adapters off
+    alpha: float = 16.0        # scale numerator: delta is scaled alpha/rank
+    dropout: float = 0.0       # dropout on the adapter input path (train only)
+    # Which dense layers carry adapters. Subset of ADAPTER_TARGETS.
+    target_modules: tuple = ADAPTER_TARGETS
+
+    def __post_init__(self) -> None:
+        # Coerce a YAML-loaded list to tuple: ModelConfig must stay
+        # HASHABLE (generate() jits with the model as a static arg), and
+        # a list-valued field would make every config loaded from YAML
+        # raise "unhashable type" at the first generate call.
+        if not isinstance(self.target_modules, tuple):
+            object.__setattr__(
+                self, "target_modules", tuple(self.target_modules)
+            )
+        if self.rank < 0:
+            raise ValueError(f"adapter rank must be >= 0, got {self.rank}")
+        if self.rank > 0 and self.alpha <= 0:
+            raise ValueError(f"adapter alpha must be > 0, got {self.alpha}")
+        if not 0.0 <= self.dropout < 1.0:
+            raise ValueError(
+                f"adapter dropout must be in [0, 1), got {self.dropout}"
+            )
+        unknown = [t for t in self.target_modules if t not in ADAPTER_TARGETS]
+        if unknown:
+            raise ValueError(
+                f"unknown adapter target_modules {unknown}; valid: "
+                f"{list(ADAPTER_TARGETS)}"
+            )
+        if self.rank > 0 and not self.target_modules:
+            raise ValueError("adapter rank > 0 with empty target_modules")
+
+    @property
+    def scale(self) -> float:
+        """The delta coefficient alpha/rank (0.0 when disabled)."""
+        return self.alpha / self.rank if self.rank > 0 else 0.0
+
 
 @dataclass(frozen=True)
 class ModelConfig:
@@ -85,6 +144,9 @@ class ModelConfig:
     # generate() API discharges them automatically (its static length
     # validation already makes them unreachable from that path).
     debug_checks: bool = False
+    # --- LoRA adapters (dtc_tpu/adapters/; rank 0 = off, the default —
+    # the model is then bitwise the pre-adapter model). See AdapterConfig.
+    adapter: AdapterConfig = field(default_factory=AdapterConfig)
 
     def __post_init__(self) -> None:
         if self.d_model % self.n_heads != 0:
@@ -113,6 +175,24 @@ class ModelConfig:
             raise ValueError(
                 f"unknown decode_attention {self.decode_attention!r}; "
                 "expected 'fused' or 'xla'"
+            )
+        # Cross-field: with MoE, the dense fc1/fc2 layers don't exist, so
+        # an adapter targeting only them would create ZERO injection
+        # sites — lora_enabled() would read True while the model has no
+        # "lora" collection, and every downstream entry point would die
+        # with a misleading error. Reject it here, loudly.
+        if (
+            self.moe_experts > 0
+            and self.adapter.rank > 0
+            and not any(
+                t not in ("fc1", "fc2") for t in self.adapter.target_modules
+            )
+        ):
+            raise ValueError(
+                "adapter.target_modules contains only fc1/fc2, but "
+                f"moe_experts={self.moe_experts} replaces the dense MLP — "
+                "no adapter site would exist; target at least one attention "
+                "projection (q_proj/k_proj/v_proj/out_proj)"
             )
         # Block sizes must be positive HERE: a negative value slips through
         # flash_attention.supports() (Python modulo of negatives is
@@ -468,6 +548,16 @@ class ServeConfig:
     shed_policy: str = "priority"
     degrade_watermark: float = 0.0
     degrade_max_new_tokens: int = 16
+    # Multi-tenant adapters (dtc_tpu/adapters/): resident stacked-factor
+    # slots for an adapter-enabled model (ModelConfig.adapter.rank > 0).
+    # Slot 0 is pinned to the all-zero "base" adapter (un-adapted
+    # requests), so max_adapters - 1 tenants can be resident at once;
+    # loading one more evicts the least-recently-used tenant with no
+    # in-flight requests (typed AdapterStoreFullError when none is
+    # evictable). Loading/evicting writes into the resident buffer at a
+    # TRACED slot — it never recompiles the decode step (audited:
+    # serve_decode baseline). Ignored when the model has no adapters.
+    max_adapters: int = 8
     # Verify completed KV pages' integrity checksums every N scheduler
     # iterations (0 = off). Detection cost is one reduction per resident
     # page; a mismatch evicts the damaged request for bit-exact
@@ -516,6 +606,11 @@ class ServeConfig:
             )
         if self.deadline_s < 0 or self.verify_pages_every < 0:
             raise ValueError("deadline_s/verify_pages_every must be >= 0")
+        if self.max_adapters < 2:
+            raise ValueError(
+                "max_adapters must be >= 2 (slot 0 is the pinned base "
+                "adapter; at least one tenant slot must remain)"
+            )
         if (
             self.chaos.enabled
             and self.chaos.serve_corrupt_page_at_step > 0

@@ -47,7 +47,8 @@ def init_cache(model, batch_size: int) -> PyTree:
     return jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), shapes["cache"])
 
 
-def decode_step(model, params: PyTree, cache: PyTree, tok: jax.Array):
+def decode_step(model, params: PyTree, cache: PyTree, tok: jax.Array,
+                lora: PyTree | None = None):
     """ONE decode iteration: apply the model to ``tok`` (B, T_new) with the
     KV cache threaded through, returning ``(new_cache, logits)`` with
     logits ``(B, T_new, V)``.
@@ -59,10 +60,18 @@ def decode_step(model, params: PyTree, cache: PyTree, tok: jax.Array):
     over its fixed slot batch (per-slot frontiers via a ``(B,)`` cache
     index), and once per admission as the prefill over a padded prompt.
     One definition means the serving path cannot drift numerically from
-    the generate path the parity tests pin."""
+    the generate path the parity tests pin.
+
+    ``lora`` is the model's "lora" collection for an adapter-enabled model
+    (``cfg.adapter.rank > 0``): one shared adapter as-initialized
+    (per-site ``(L, in, r)`` factors), or the serving engine's per-slot
+    gathered stack (``(L, B, in, r)`` — each batch row decodes under its
+    own tenant's adapter). Required iff the model has adapters."""
+    variables = {"params": params, "cache": cache}
+    if lora is not None:
+        variables["lora"] = lora
     logits, mutated = model.apply(
-        {"params": params, "cache": cache}, tok,
-        train=False, decode=True, mutable=["cache"],
+        variables, tok, train=False, decode=True, mutable=["cache"],
     )
     return mutated["cache"], logits
 
@@ -97,6 +106,7 @@ def _generate_impl(
     temperature: float = 0.0,
     top_k: int | None = None,
     top_p: float | None = None,
+    lora: PyTree | None = None,
 ) -> jax.Array:
     """Sample ``max_new_tokens`` continuations of ``prompt`` (B, T_prompt).
 
@@ -154,22 +164,24 @@ def _generate_impl(
     # named_scope (ISSUE 8): the device-time attribution separates the
     # prompt pass from the token scan by these scopes — the decode leg of
     # the same provenance the train step's fwd/optimizer scopes provide.
+    # ``lora`` (one shared adapter for the whole batch) is loop-invariant:
+    # closed over by the scan body, read every step, never carried.
     with jax.named_scope("prefill"):
-        cache, logits = decode_step(model, params, cache, prompt)
+        cache, logits = decode_step(model, params, cache, prompt, lora)
     rng, sub = jax.random.split(rng)
     first = sample(logits[:, -1], sub)
 
     if greedy:
         def body(carry, _):
             cache, tok = carry
-            cache, logits = decode_step(model, params, cache, tok[:, None])
+            cache, logits = decode_step(model, params, cache, tok[:, None], lora)
             nxt = sample(logits[:, -1], None)
             return (cache, nxt), nxt
         init = (cache, first)
     else:
         def body(carry, _):
             cache, tok, key = carry
-            cache, logits = decode_step(model, params, cache, tok[:, None])
+            cache, logits = decode_step(model, params, cache, tok[:, None], lora)
             key, sub = jax.random.split(key)
             nxt = sample(logits[:, -1], sub)
             return (cache, nxt, key), nxt
@@ -199,6 +211,7 @@ def generate(
     temperature: float = 0.0,
     top_k: int | None = None,
     top_p: float | None = None,
+    lora: PyTree | None = None,
     tracer=None,
 ) -> jax.Array:
     """See :func:`_generate_impl` for semantics; this wrapper picks the
@@ -221,7 +234,7 @@ def generate(
         ):
             out = generate(
                 model, params, prompt, max_new_tokens, rng,
-                temperature=temperature, top_k=top_k, top_p=top_p,
+                temperature=temperature, top_k=top_k, top_p=top_p, lora=lora,
             )
             # Sync INSIDE the span so it measures device work, not the
             # async dispatch returning (the bracketed call is host-side).
@@ -230,16 +243,16 @@ def generate(
     if getattr(model.cfg, "debug_checks", False):
         from jax.experimental import checkify
 
-        def f(params, prompt, rng):
+        def f(params, prompt, rng, lora):
             return _generate_impl(
                 model, params, prompt, max_new_tokens, rng,
-                temperature=temperature, top_k=top_k, top_p=top_p,
+                temperature=temperature, top_k=top_k, top_p=top_p, lora=lora,
             )
 
-        err, out = jax.jit(checkify.checkify(f))(params, prompt, rng)
+        err, out = jax.jit(checkify.checkify(f))(params, prompt, rng, lora)
         err.throw()
         return out
     return _generate_jit(
         model, params, prompt, max_new_tokens, rng,
-        temperature=temperature, top_k=top_k, top_p=top_p,
+        temperature=temperature, top_k=top_k, top_p=top_p, lora=lora,
     )

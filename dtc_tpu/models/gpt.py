@@ -37,6 +37,7 @@ import jax
 import jax.numpy as jnp
 from flax import linen as nn
 
+from dtc_tpu.adapters.lora import apply_lora
 from dtc_tpu.config.schema import ModelConfig
 from dtc_tpu.ops.attention import causal_attention
 
@@ -63,7 +64,15 @@ class CausalSelfAttention(nn.Module):
         pdtype = _dtype(cfg.param_dtype)
 
         def dense(name):
-            return nn.Dense(cfg.d_model, name=name, dtype=cdtype, param_dtype=pdtype)
+            # LoRA injection point (dtc_tpu/adapters/): with an active
+            # adapter config and a targeted name, the base Dense output
+            # gains a low-rank delta from the SEPARATE "lora" collection;
+            # at rank 0 apply_lora is an identity passthrough that creates
+            # no variables — the rank-0 graph is bitwise the base graph.
+            layer = nn.Dense(cfg.d_model, name=name, dtype=cdtype, param_dtype=pdtype)
+            return lambda h: apply_lora(
+                self, layer, h, cfg=cfg, name=name, train=train
+            )
 
         # named_scope component annotation (ISSUE 8): trace-time-only HLO
         # op_name provenance so XLA fusions roll up to model components in
@@ -196,6 +205,9 @@ class CausalSelfAttention(nn.Module):
 
 class MLP(nn.Module):
     cfg: ModelConfig
+    # Only consulted by the LoRA dropout path (adapters/lora.py); the base
+    # MLP has no train-dependent ops, which is why the field can default.
+    train: bool = False
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
@@ -203,10 +215,12 @@ class MLP(nn.Module):
         cdtype = _dtype(cfg.compute_dtype)
         pdtype = _dtype(cfg.param_dtype)
         with jax.named_scope("mlp"):
-            h = nn.Dense(cfg.d_ff, name="fc1", dtype=cdtype, param_dtype=pdtype)(x)
+            fc1 = nn.Dense(cfg.d_ff, name="fc1", dtype=cdtype, param_dtype=pdtype)
+            h = apply_lora(self, fc1, x, cfg=cfg, name="fc1", train=self.train)
             h = nn.gelu(h)
             h = nn.with_logical_constraint(h, ("batch", "seq", "mlp"))  # column-parallel
-            h = nn.Dense(cfg.d_model, name="fc2", dtype=cdtype, param_dtype=pdtype)(h)
+            fc2 = nn.Dense(cfg.d_model, name="fc2", dtype=cdtype, param_dtype=pdtype)
+            h = apply_lora(self, fc2, h, cfg=cfg, name="fc2", train=self.train)
             h = nn.with_logical_constraint(h, ("batch", "seq", "embed"))  # row-parallel all-reduce
         return h
 
@@ -351,7 +365,7 @@ class Block(nn.Module):
                 # backward scan skips the ~0.7 ms/layer attention recompute
                 # the "block" mode pays (measured, PERF.md round 4).
                 mlp_cls = nn.remat(MLP, prevent_cse=False)
-            ff = mlp_cls(cfg, name="mlp")(h)
+            ff = mlp_cls(cfg, train=train, name="mlp")(h)
         x = x + nn.Dropout(cfg.dropout, deterministic=not train)(ff)
         return nn.with_logical_constraint(x, ("batch", "seq", "embed"))
 
@@ -470,7 +484,12 @@ class GPTStage(nn.Module):
             cls = nn.remat(cls, **kwargs)
         scanned = nn.scan(
             cls,
-            variable_axes={"params": 0, "cache": 0, "aux_loss": 0},
+            # "lora" rides the scan like every block variable: per-layer
+            # adapter factors stack with the leading "layers" axis
+            # (training (L, in, r); the serving engine's per-slot gather
+            # feeds (L, B, in, r) and each layer sees its (B, in, r) row
+            # factors). A lora-free model simply has no such collection.
+            variable_axes={"params": 0, "cache": 0, "aux_loss": 0, "lora": 0},
             split_rngs={"params": True, "dropout": True},
             length=self.n_layers,
             metadata_params={nn.PARTITION_NAME: "layers"},
@@ -596,8 +615,37 @@ class GPT(nn.Module):
         return GPTHead(cfg, name="head")(h, targets=targets)
 
 
+def adapter_param_count(cfg: ModelConfig) -> int:
+    """Exact LoRA adapter parameter count from config (0 when disabled).
+
+    Counted SEPARATELY from :func:`param_count` on purpose: the base
+    params are frozen and shared across every tenant, while each tenant
+    pays only this subtree — the whole point of the multi-tenant design.
+    Per targeted site: ``rank * (in + out)`` for the A/B pair, per layer.
+    With ``moe_experts > 0`` the dense fc1/fc2 sites do not exist (the
+    MoE expert tensors carry no adapters), so only attention targets
+    count."""
+    a = cfg.adapter
+    if a.rank <= 0:
+        return 0
+    d, f, r = cfg.d_model, cfg.d_ff, a.rank
+    dims = {
+        "q_proj": (d, d), "k_proj": (d, d), "v_proj": (d, d),
+        "out_proj": (d, d),
+    }
+    if cfg.moe_experts == 0:
+        dims["fc1"] = (d, f)
+        dims["fc2"] = (f, d)
+    per_layer = sum(
+        r * (i + o) for t, (i, o) in dims.items() if t in tuple(a.target_modules)
+    )
+    return cfg.n_layers * per_layer
+
+
 def param_count(cfg: ModelConfig) -> int:
-    """Exact parameter count from config (no tracing needed)."""
+    """Exact BASE parameter count from config (no tracing needed).
+    LoRA adapter params are deliberately excluded — they are per-tenant
+    and counted by :func:`adapter_param_count`."""
     d, v, L, f, s = cfg.d_model, cfg.padded_vocab_size, cfg.n_layers, cfg.d_ff, cfg.max_seq_len
     embed = v * d + s * d
     if cfg.moe_experts > 0:

@@ -310,6 +310,13 @@ class MetricsRegistry:
     def histogram(self, name: str) -> Histogram:
         return self._hists.setdefault(name, Histogram(name))
 
+    def drop_histogram(self, name: str) -> None:
+        """Forget one histogram (no-op when absent). For DYNAMICALLY named
+        instruments (the serving engine's per-tenant histograms): a
+        long-lived process must prune the instrument when its subject is
+        retired, or registry memory grows with every name ever seen."""
+        self._hists.pop(name, None)
+
     # -- events -----------------------------------------------------------
     def emit(self, etype: str, **fields: Any) -> dict[str, Any]:
         event: dict[str, Any] = {

@@ -18,6 +18,7 @@ bit-exact in tier-1 CPU tests. See README "Serving runtime".
 from dtc_tpu.serve.engine import ServingEngine, init_slot_cache
 from dtc_tpu.serve.paged_cache import PageAllocator, pages_for
 from dtc_tpu.serve.request import (
+    AdapterStoreFullError,
     DeadlineExceededError,
     QueueFullError,
     Request,
@@ -28,9 +29,11 @@ from dtc_tpu.serve.request import (
     ServeResult,
     ShedError,
     TransientStepError,
+    UnknownAdapterError,
 )
 
 __all__ = [
+    "AdapterStoreFullError",
     "DeadlineExceededError",
     "PageAllocator",
     "QueueFullError",
@@ -43,6 +46,7 @@ __all__ = [
     "ServingEngine",
     "ShedError",
     "TransientStepError",
+    "UnknownAdapterError",
     "init_slot_cache",
     "pages_for",
 ]

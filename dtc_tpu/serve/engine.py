@@ -38,6 +38,15 @@ vLLM-style paged budget, adapted to the model-native packed cache):
 - SLO accounting through ``obs``: queue-wait / TTFT / ms-per-token
   histograms, shed/evict/expire/reject/retry counters, and one
   ``serve_request`` event per terminal request — no silent drops.
+- Multi-tenant LoRA adapters (``dtc_tpu/adapters/``, model config
+  ``adapter.rank > 0``): one resident ``(max_adapters, ...)`` stacked
+  factor buffer over ONE base model — slot 0 pinned to the all-zero base
+  adapter — with per-slot adapter indices gathered inside the jitted
+  step, so admitting a new tenant (or ``load_adapter`` writing factors at
+  a traced stack slot) never recompiles. Requests name their tenant
+  (``Request.adapter``); the store pins it (refcount) from submit to
+  terminal; per-tenant TTFT/ms-per-token histograms and ``adapter_*``
+  events ride the same registry.
 """
 
 from __future__ import annotations
@@ -50,6 +59,14 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from dtc_tpu.adapters import (
+    BASE_SLOT,
+    AdapterStore,
+    gather_slot_lora,
+    init_lora_stack,
+    lora_enabled,
+    validate_lora_tree,
+)
 from dtc_tpu.generate import decode_step, init_cache
 from dtc_tpu.obs.registry import MetricsRegistry
 from dtc_tpu.obs.slo import SloMonitor
@@ -70,6 +87,7 @@ from dtc_tpu.serve.request import (
     ServeResult,
     ShedError,
     TransientStepError,
+    UnknownAdapterError,
 )
 
 PyTree = Any
@@ -193,6 +211,23 @@ class ServingEngine:
         )
         self.alloc = PageAllocator(pool, cfg.page_size)
 
+        # Multi-tenant adapters (dtc_tpu/adapters/): with an adapter-
+        # enabled model, ONE resident (max_adapters, ...) stacked-factor
+        # buffer serves every tenant — slot 0 is the all-zero base
+        # adapter, per-request indices gather per-SLOT factors inside the
+        # jitted step, and load_adapter() writes a tenant's factors at a
+        # TRACED stack slot. Values change, shapes never do: tenant churn
+        # cannot recompile (audited: serve_decode baseline).
+        self.lora_on = lora_enabled(self.mcfg)
+        if self.lora_on:
+            self.adapter_store = AdapterStore(cfg.max_adapters)
+            self.lora_stack = init_lora_stack(model, cfg.max_adapters)
+            self.slot_adapter = np.zeros((cfg.slots,), np.int32)
+        else:
+            self.adapter_store = None
+            self.lora_stack = None
+            self.slot_adapter = None
+
         self.cache = init_slot_cache(model, cfg.slots)
         self.slots = [_Slot() for _ in range(cfg.slots)]
         self.last_tok = np.zeros((cfg.slots,), np.int32)
@@ -216,32 +251,78 @@ class ServingEngine:
     # ------------------------------------------------------------------
     def _build_fns(self) -> None:
         model = self.model
+        lora_on = self.lora_on
 
-        @jax.jit
-        def step_fn(params, cache, toks):
+        # ONE decode/prefill core shared by both compiled flavors — the
+        # post-processing (greedy argmax matching generate()'s fast path,
+        # the per-slot finite flag that detects poisoned logits, the
+        # n_valid row selection) must never diverge between the lora and
+        # adapter-free programs; only the signature (and the per-slot
+        # factor gather) differs per branch below.
+        def step_core(params, cache, toks, lora):
             """One continuous-batching decode iteration over ALL slots
             (idle slots compute garbage that is masked/overwritten before
-            any read — fixed shapes are what keep this recompile-free).
-            Greedy argmax matches generate()'s greedy fast path exactly;
-            the per-slot finite flag is the poisoned-logits detector."""
-            cache, logits = decode_step(model, params, cache, toks[:, None])
+            any read — fixed shapes are what keep this recompile-free)."""
+            cache, logits = decode_step(model, params, cache, toks[:, None], lora)
             last = logits[:, -1]
             nxt = jnp.argmax(last, axis=-1).astype(jnp.int32)
             finite = jnp.all(jnp.isfinite(last.astype(jnp.float32)), axis=-1)
             return cache, nxt, finite
 
-        @jax.jit
-        def prefill_fn(params, cache, prompt, n_valid):
+        def prefill_core(params, cache, prompt, n_valid, lora):
             """Batch-1 prefill over a bucket-padded prompt chunk starting
             at the cache's current scalar frontier. Samples the next token
             from the last VALID row (pad rows' outputs are discarded; pad
             K/V lands beyond the frontier the insert below pins, so it is
             masked until real decode overwrites it)."""
-            cache, logits = decode_step(model, params, cache, prompt)
+            cache, logits = decode_step(model, params, cache, prompt, lora)
             row = logits[0, n_valid - 1]
             tok = jnp.argmax(row, axis=-1).astype(jnp.int32)
             finite = jnp.all(jnp.isfinite(row.astype(jnp.float32)))
             return cache, tok, finite
+
+        if lora_on:
+            # Adapter mode: the step/prefill signatures grow the resident
+            # factor stack + per-slot adapter indices, gathered INSIDE the
+            # one compiled step — tenant admission is a value change,
+            # never a shape change (the recompile-free invariant the
+            # serve_decode audit baseline pins across adapter load +
+            # mixed-tenant admission).
+            @jax.jit
+            def step_fn(params, stack, aids, cache, toks):
+                return step_core(
+                    params, cache, toks, gather_slot_lora(stack, aids)
+                )
+
+            @jax.jit
+            def prefill_fn(params, stack, aid, cache, prompt, n_valid):
+                return prefill_core(
+                    params, cache, prompt, n_valid,
+                    gather_slot_lora(stack, aid),  # aid: (1,) index
+                )
+
+            @jax.jit
+            def adapter_insert_fn(stack, factors, slot):
+                """Hot adapter load: write one tenant's factors into stack
+                row ``slot``. ``slot`` is traced — loading into any slot
+                reuses this one executable (the stack-side twin of the
+                cache-surgery ``insert_fn`` below)."""
+                def leaf(s, f):
+                    return jax.lax.dynamic_update_slice(
+                        s, f[None].astype(s.dtype), (slot,) + (0,) * f.ndim
+                    )
+
+                return jax.tree.map(leaf, stack, factors)
+
+            self._adapter_insert_fn = adapter_insert_fn
+        else:
+            @jax.jit
+            def step_fn(params, cache, toks):
+                return step_core(params, cache, toks, None)
+
+            @jax.jit
+            def prefill_fn(params, cache, prompt, n_valid):
+                return prefill_core(params, cache, prompt, n_valid, None)
 
         @jax.jit
         def insert_fn(batch_cache, row_cache, slot, n_tokens):
@@ -357,15 +438,34 @@ class ServingEngine:
                 f"{pages_for(total, self.cfg.page_size)} pages exceeds the "
                 f"pool ({self.alloc.total_pages})"
             )
+        if req.adapter is not None and (
+            not self.lora_on or req.adapter not in self.adapter_store
+        ):
+            self.reg.counter("serve_rejected").inc()
+            self.reg.emit(
+                "serve_reject", rid=req.rid, reason="unknown_adapter",
+                adapter=req.adapter,
+            )
+            raise UnknownAdapterError(
+                f"request {req.rid}: adapter {req.adapter!r} is not resident"
+                + ("" if self.lora_on else
+                   " (model has no adapter support: adapter.rank == 0)")
+            )
         if len(self.queue) >= self.cfg.queue_depth:
             self.reg.counter("serve_rejected").inc()
             self.reg.emit("serve_reject", rid=req.rid, reason="queue_full")
             raise QueueFullError(
                 f"request {req.rid}: queue at depth {self.cfg.queue_depth}"
             )
+        if req.adapter is not None:
+            # Pinned from submit to terminal: an in-flight tenant's
+            # factors can never be LRU-evicted out from under it (the
+            # eviction→re-prefill recovery path depends on this).
+            self.adapter_store.acquire(req.adapter)
         self.requests[req.rid] = req
         self.results[req.rid] = ServeResult(
-            rid=req.rid, state=RequestState.QUEUED, tokens=[], submitted_t=now
+            rid=req.rid, state=RequestState.QUEUED, tokens=[],
+            submitted_t=now, adapter=req.adapter,
         )
         ttl = self.cfg.deadline_s if req.deadline_s is None else req.deadline_s
         self._deadline[req.rid] = now + ttl if ttl and ttl > 0 else float("inf")
@@ -384,6 +484,61 @@ class ServingEngine:
         for rid in done:
             del self.results[rid]
         return done
+
+    # ------------------------------------------------------------------
+    # multi-tenant adapters
+    # ------------------------------------------------------------------
+    def load_adapter(self, name: str, factors: PyTree) -> int:
+        """Make tenant ``name``'s LoRA factors resident; returns its stack
+        slot. ``factors`` is the per-adapter "lora" tree (the finetune
+        export — :func:`dtc_tpu.adapters.load_adapter_file` with the
+        engine's stack as ``like``, or a ``TrainResult.state.params``).
+
+        Loading is a device-side write at a TRACED slot index into the
+        fixed-shape resident stack, so it NEVER recompiles the decode
+        step, even mid-flight with other tenants decoding (audited:
+        serve_decode baseline). A full store evicts the least-recently-
+        used idle tenant (``adapter_evict`` event); when every tenant has
+        in-flight requests the load fails typed
+        (:class:`AdapterStoreFullError`). Re-loading a resident name
+        overwrites its factors in place (a hot adapter update) and drops
+        any prefix KV built under the old factors; it raises ValueError
+        while that tenant has in-flight requests (their decode would fork
+        from the KV already computed)."""
+        if not self.lora_on:
+            raise ValueError(
+                "load_adapter on a lora-free engine (model adapter.rank == "
+                "0); serve an adapter-enabled model config"
+            )
+        validate_lora_tree(self.lora_stack, factors)
+        slot, evicted = self.adapter_store.register(name)
+        if evicted is not None:
+            self.reg.counter("adapter_evictions").inc()
+            self.reg.emit(
+                "adapter_evict", name=evicted, slot=slot, iteration=self._it,
+                reason="store_lru",
+            )
+            # The evicted tenant is fully retired: its prefix KV is
+            # unreachable-by-correctness (a later SAME-NAME load may carry
+            # different factors) and its per-tenant histograms must not
+            # accrete forever under tenant churn.
+            self._drop_adapter_prefixes(evicted)
+            self.reg.drop_histogram(f"serve_ttft_s.{evicted}")
+            self.reg.drop_histogram(f"serve_ms_per_token.{evicted}")
+        # A (re)load changes the factors behind the name, so any prefix KV
+        # built under the OLD factors is stale — reusing it would decode
+        # the suffix under new factors against old-prefix KV bytes. Drop
+        # the name's entries; the next admission rebuilds them.
+        self._drop_adapter_prefixes(name)
+        self.lora_stack = self._adapter_insert_fn(
+            self.lora_stack, factors, jnp.int32(slot)
+        )
+        self.reg.counter("adapter_loads").inc()
+        self.reg.emit(
+            "adapter_load", name=name, slot=slot, iteration=self._it,
+            params=int(sum(np.prod(np.shape(f)) for f in jax.tree.leaves(factors))),
+        )
+        return slot
 
     # ------------------------------------------------------------------
     # the scheduler iteration
@@ -557,11 +712,17 @@ class ServingEngine:
     def _prefix_base(self, req: Request) -> tuple[PyTree, int]:
         """(base cache, base length) for this request's prefill: the
         shared-prefix store entry when one matches (prefilled once,
-        reused by every admission), else a fresh batch-1 cache."""
+        reused by every admission), else a fresh batch-1 cache.
+
+        Prefix keys are scoped PER ADAPTER: the same token prefix under
+        two tenants yields different KV bytes (the adapter reshapes the
+        k/v projections), so each (adapter, tokens) pair holds its own
+        store entry — per-tenant system prompts still share across that
+        tenant's requests."""
         plen = min(req.shared_prefix_len, len(req.prompt) - 1)
         if plen <= 0:
             return init_cache(self.model, 1), 0
-        key = tuple(int(t) for t in req.prompt[:plen])
+        key = (req.adapter,) + tuple(int(t) for t in req.prompt[:plen])
         if key in self._prefix_store:
             self.alloc.touch_prefix(key)
             self.reg.counter("serve_prefix_hits").inc()
@@ -577,11 +738,13 @@ class ServingEngine:
         if not fits:
             return init_cache(self.model, 1), 0  # no room: skip sharing
         padded = _pad_to_bucket(
-            list(key), self.cfg.prefill_bucket, self.mcfg.max_seq_len
+            [int(t) for t in req.prompt[:plen]], self.cfg.prefill_bucket,
+            self.mcfg.max_seq_len,
         )
         try:
             cache, _tok, _fin = self._checked_prefill(
-                init_cache(self.model, 1), padded, plen
+                init_cache(self.model, 1), padded, plen,
+                adapter_slot=self._adapter_slot(req),
             )
         except TransientStepError:
             # The entry was never stored: un-account its pinned pages or
@@ -599,15 +762,46 @@ class ServingEngine:
         self.reg.counter("serve_prefix_builds").inc()
         return self._prefix_store[key]
 
-    def _checked_prefill(self, base: PyTree, padded: list[int], n_valid: int):
+    def _drop_adapter_prefixes(self, name: str) -> None:
+        """Invalidate every shared-prefix store entry built under adapter
+        ``name`` (prefix keys are ``(adapter, *tokens)``), returning their
+        pages to the pool."""
+        for key in [k for k in self._prefix_store if k and k[0] == name]:
+            self._prefix_store.pop(key, None)
+            self.alloc.drop_prefix(key)
+
+    def _adapter_slot(self, req: Request) -> int:
+        """The request's stack slot (BASE_SLOT for un-adapted requests or
+        a lora-free engine). Submit-time validation + the store refcount
+        guarantee residency from submit to terminal, so a miss here is an
+        engine bug, not a race."""
+        if not self.lora_on or req.adapter is None:
+            return BASE_SLOT
+        slot = self.adapter_store.slot_of(req.adapter)
+        if slot is None:  # pragma: no cover — refcount pins residency
+            raise UnknownAdapterError(
+                f"request {req.rid}: adapter {req.adapter!r} vanished from "
+                "the store while in flight"
+            )
+        return slot
+
+    def _checked_prefill(self, base: PyTree, padded: list[int], n_valid: int,
+                         adapter_slot: int = BASE_SLOT):
         """Prefill + finite check under the transient-fault retry (the
         production path poisoned logits and injected device faults take)."""
         prompt = jnp.asarray(np.asarray(padded, np.int32)[None])
 
         def attempt():
-            cache, tok, fin = self._prefill_fn(
-                self.params, base, prompt, jnp.int32(n_valid)
-            )
+            if self.lora_on:
+                cache, tok, fin = self._prefill_fn(
+                    self.params, self.lora_stack,
+                    jnp.asarray([adapter_slot], jnp.int32), base, prompt,
+                    jnp.int32(n_valid),
+                )
+            else:
+                cache, tok, fin = self._prefill_fn(
+                    self.params, base, prompt, jnp.int32(n_valid)
+                )
             if not bool(np.asarray(fin)):
                 raise TransientStepError("prefill produced non-finite logits")
             self.reg.counter("serve_prefills").inc()
@@ -659,7 +853,9 @@ class ServingEngine:
                 suffix, self.cfg.prefill_bucket, self.mcfg.max_seq_len - base_len
             )
             self._retry_scope = [req.rid]
-            cache1, tok, _fin = self._checked_prefill(base, padded, len(suffix))
+            cache1, tok, _fin = self._checked_prefill(
+                base, padded, len(suffix), adapter_slot=self._adapter_slot(req)
+            )
         except TransientStepError as e:
             self._release_slot(req.rid)  # return the reserved pages
             err = RequestFailedError(
@@ -676,6 +872,11 @@ class ServingEngine:
         slot.rid = req.rid
         slot.frontier = len(seq)
         slot.page_fp = {}
+        if self.lora_on:
+            # The slot now decodes under this request's adapter: one host
+            # int per slot, shipped to the step as the (slots,) gather
+            # index vector (same lifecycle as last_tok).
+            self.slot_adapter[slot_i] = self._adapter_slot(req)
         if self._track_pages and len(seq) >= self.cfg.page_size:
             fps = self._page_fps()
             for p in range(len(seq) // self.cfg.page_size):
@@ -691,6 +892,13 @@ class ServingEngine:
             self.reg.histogram("serve_queue_wait_s").observe(
                 res.queue_wait_s or 0.0
             )
+            if self.lora_on:
+                # Per-tenant TTFT: one histogram per adapter name ("base"
+                # for un-adapted requests) next to the aggregate — the
+                # SLO surface a noisy-neighbor tenant shows up on.
+                self.reg.histogram(
+                    f"serve_ttft_s.{req.adapter or 'base'}"
+                ).observe(res.ttft_s or 0.0)
             if self.slo is not None:
                 self.slo.observe("serve_ttft_s", res.ttft_s)
                 self.slo.observe("serve_queue_wait_s", res.queue_wait_s)
@@ -716,7 +924,7 @@ class ServingEngine:
         self.reg.counter("serve_admissions").inc()
         self.reg.emit(
             "serve_admit", rid=req.rid, slot=slot_i, resident=len(seq),
-            prefix_len=base_len, iteration=self._it,
+            prefix_len=base_len, iteration=self._it, adapter=req.adapter,
         )
         self._maybe_complete(slot_i)
 
@@ -758,9 +966,18 @@ class ServingEngine:
         toks = jnp.asarray(self.last_tok)
         last_fin = np.ones((self.cfg.slots,), bool)
 
+        aids = (
+            jnp.asarray(self.slot_adapter) if self.lora_on else None
+        )
+
         def attempt():
             nonlocal last_fin
-            cache, nxt, fin = self._step_fn(self.params, prev_cache, toks)
+            if self.lora_on:
+                cache, nxt, fin = self._step_fn(
+                    self.params, self.lora_stack, aids, prev_cache, toks
+                )
+            else:
+                cache, nxt, fin = self._step_fn(self.params, prev_cache, toks)
             nxt = np.asarray(nxt)
             fin = np.asarray(fin).copy()
             if self.chaos is not None and self.chaos.serve_poison_logits(
@@ -936,11 +1153,13 @@ class ServingEngine:
             self._finish(rid, RequestState.DONE, None, now=now)
 
     def _release_slot(self, rid: str) -> None:
-        for slot in self.slots:
+        for i, slot in enumerate(self.slots):
             if slot.rid == rid:
                 slot.rid = None
                 slot.frontier = 0
                 slot.page_fp = {}
+                if self.lora_on:
+                    self.slot_adapter[i] = BASE_SLOT
         self.alloc.free(rid)
 
     def _finish(
@@ -955,10 +1174,18 @@ class ServingEngine:
         # server must not grow with total requests served.
         self._deadline.pop(rid, None)
         self._eff_max_new.pop(rid, None)
-        self.requests.pop(rid, None)
+        req = self.requests.pop(rid, None)
+        if (
+            self.lora_on and req is not None and req.adapter is not None
+        ):
+            self.adapter_store.release(req.adapter)  # unpin at terminal
         self.reg.counter(f"serve_{state.value}").inc()
         if state is RequestState.DONE and res.ms_per_token is not None:
             self.reg.histogram("serve_ms_per_token").observe(res.ms_per_token)
+            if self.lora_on:
+                self.reg.histogram(
+                    f"serve_ms_per_token.{res.adapter or 'base'}"
+                ).observe(res.ms_per_token)
             if self.slo is not None:
                 self.slo.observe("serve_ms_per_token", res.ms_per_token)
         if self.slo is not None:

@@ -90,6 +90,19 @@ class TransientStepError(ServeError):
     the pre-step cache via ``resilience.retry.retry_call``."""
 
 
+class UnknownAdapterError(ServeError):
+    """The request names an adapter that is not resident in the engine's
+    adapter store (or the model was built without adapter support).
+    Raised at ``submit()`` — load the adapter first
+    (``ServingEngine.load_adapter``)."""
+
+
+class AdapterStoreFullError(ServeError):
+    """``load_adapter`` found every tenant slot held by an adapter with
+    in-flight requests — nothing is LRU-evictable. Typed backpressure:
+    drain or wait, never a silent overwrite of a live tenant's factors."""
+
+
 @dataclasses.dataclass
 class Request:
     """One generation request.
@@ -97,9 +110,14 @@ class Request:
     ``shared_prefix_len`` marks the first N prompt tokens as a shareable
     prefix (a common system prompt): concurrent requests with an identical
     prefix reuse its KV pages from the prefix store instead of
-    re-prefilling it. ``deadline_s`` is relative to submit time and
+    re-prefilling it. Prefix entries are scoped PER ADAPTER — the same
+    token prefix under two tenants holds two store entries, because their
+    KV bytes differ. ``deadline_s`` is relative to submit time and
     overrides the config default (None = use default; 0 = no deadline).
     Higher ``priority`` is better; sheds take the lowest first.
+    ``adapter`` names a tenant LoRA adapter previously loaded with
+    ``ServingEngine.load_adapter`` (None = the base model); the adapter
+    stays pinned in the store from submit to the terminal state.
     """
 
     rid: str
@@ -109,6 +127,7 @@ class Request:
     deadline_s: float | None = None
     eos_id: int | None = None
     shared_prefix_len: int = 0
+    adapter: str | None = None
 
     def __post_init__(self) -> None:
         if len(self.prompt) < 1:
@@ -143,6 +162,7 @@ class ServeResult:
     n_evictions: int = 0
     n_retries: int = 0
     degraded: bool = False               # max_new_tokens shrunk at admission
+    adapter: str | None = None           # tenant adapter (None = base)
     # Eviction re-queue time: the next req.queued trace span starts here
     # instead of at submit (cleared on re-admission; never in summary()).
     requeued_t: float | None = None
@@ -184,4 +204,5 @@ class ServeResult:
             "n_evictions": self.n_evictions,
             "n_retries": self.n_retries,
             "degraded": self.degraded,
+            "adapter": self.adapter,
         }

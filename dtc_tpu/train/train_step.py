@@ -130,6 +130,7 @@ def create_gspmd_train_step(
     mesh: Mesh,
     rules: Sequence[tuple[str, str | None]] = DEFAULT_RULES,
     state: TrainState | None = None,
+    base_params: PyTree | None = None,
 ) -> Callable[[TrainState, Batch, jax.Array], tuple[TrainState, jax.Array]]:
     """Build the jitted DP/TP/DP×TP train step.
 
@@ -140,6 +141,12 @@ def create_gspmd_train_step(
     Passing the (placed) initial ``state`` pins the step's out_shardings to
     the state's shardings, so every call hits ONE executable — see
     :func:`state_shardings` for the double-compile this avoids.
+
+    With ``base_params`` (the LoRA finetune path, dtc_tpu/adapters/) the
+    state holds ONLY the adapter ("lora") subtree: the frozen base rides
+    in as a non-donated, non-differentiated argument, gradients and the
+    optimizer update touch the adapter alone — which is exactly what makes
+    adapter checkpoints/rollback operate on the tiny subtree for free.
     """
     jit_kwargs: dict[str, Any] = {"donate_argnums": (0,)}
     if state is not None:
@@ -178,29 +185,69 @@ def create_gspmd_train_step(
             state = state.apply_gradients(grads=grads)
         return state, loss
 
-    return train_step
+    if base_params is None:
+        return train_step
+
+    @functools.partial(jax.jit, **jit_kwargs)
+    def lora_step(
+        state: TrainState, base: PyTree, batch: Batch, rng: jax.Array
+    ):
+        x = nn.with_logical_constraint(batch.x, ("batch", "seq"))
+        y = nn.with_logical_constraint(batch.y, ("batch", "seq"))
+
+        def loss_fn(lora: PyTree) -> jax.Array:
+            with jax.named_scope("fwd"):
+                loss, mut = state.apply_fn(
+                    {"params": base, "lora": lora}, x, train=True,
+                    rngs={"dropout": rng}, targets=y, mutable=["aux_loss"],
+                )
+                return loss + sum_aux_loss(mut)
+
+        # Differentiate ONLY the adapter subtree; base param gradients are
+        # never formed (frozen base — not stop_gradient'd post hoc).
+        loss, grads = jax.value_and_grad(loss_fn)(state.params)
+        with jax.named_scope("optimizer"):
+            state = state.apply_gradients(grads=grads)
+        return state, loss
+
+    # Bind the frozen base as an EXPLICIT (traced, undonated) argument —
+    # not a closure constant, which would bake the full base weights into
+    # the jaxpr — while keeping the trainer-facing (state, batch, rng)
+    # signature every call site already uses.
+    def step(state: TrainState, batch: Batch, rng: jax.Array):
+        return lora_step(state, base_params, batch, rng)
+
+    return step
 
 
 def create_eval_step(
     mesh: Mesh,
     model,
     rules: Sequence[tuple[str, str | None]] = DEFAULT_RULES,
+    base_params: PyTree | None = None,
 ) -> Callable[[PyTree, Batch], jax.Array]:
     """Jitted loss-only evaluation step (no dropout, no update).
 
     Takes bare params (not a TrainState) so the trainer can feed it
     unstacked pipeline params: eval always runs the plain GSPMD forward,
-    whatever strategy training uses.
+    whatever strategy training uses. With ``base_params`` (adapter runs)
+    the first argument is the LoRA subtree instead — the same thing the
+    trainer's ``state.params`` holds in that mode — and the frozen base
+    rides in as a bound argument.
     """
 
     @jax.jit
-    def eval_step(params: PyTree, batch: Batch) -> jax.Array:
+    def eval_step(params: PyTree, base: PyTree | None, batch: Batch) -> jax.Array:
         x = nn.with_logical_constraint(batch.x, ("batch", "seq"))
         y = nn.with_logical_constraint(batch.y, ("batch", "seq"))
-        logits = model.apply({"params": params}, x, train=False)
+        variables = (
+            {"params": params} if base is None
+            else {"params": base, "lora": params}
+        )
+        logits = model.apply(variables, x, train=False)
         return cross_entropy_loss(logits, y)
 
-    return eval_step
+    return lambda params, batch: eval_step(params, base_params, batch)
 
 
 def create_train_step(
@@ -212,12 +259,21 @@ def create_train_step(
     pp_schedule: str = "gpipe",
     pp_virtual: int = 1,
     state: TrainState | None = None,
+    base_params: PyTree | None = None,
 ):
     """Strategy-dispatching factory: GSPMD step, or pipeline step when the
     mesh has a non-trivial ``pipe`` axis (GPipe, or plain/interleaved 1F1B
     per ``pp_schedule`` / ``pp_virtual``). ``state`` (optional, GSPMD path)
-    pins out_shardings to avoid the layout-churn double compile."""
+    pins out_shardings to avoid the layout-churn double compile.
+    ``base_params`` selects the LoRA-adapter step (state = adapter subtree,
+    base frozen) — GSPMD modes only."""
     if mesh.shape.get("pipe", 1) > 1:
+        if base_params is not None:
+            raise ValueError(
+                "LoRA adapter training (base_params) is not supported under "
+                "pipeline parallelism; use a mesh with pipe == 1 (adapters "
+                "compose with DP/TP/FSDP)"
+            )
         assert model is not None, "pipeline step needs the model for staged apply"
         if pp_schedule == "1f1b":
             from dtc_tpu.parallel.pipeline import create_1f1b_train_step
@@ -231,4 +287,6 @@ def create_train_step(
         return create_pp_train_step(
             model, mesh, num_microbatches=num_microbatches, rules=rules
         )
-    return create_gspmd_train_step(mesh, rules, state=state)
+    return create_gspmd_train_step(
+        mesh, rules, state=state, base_params=base_params
+    )

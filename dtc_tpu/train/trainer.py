@@ -62,6 +62,10 @@ class TrainResult:
     elapsed_times: list[float] = field(default_factory=list)
     eval_losses: list[tuple[int, float]] = field(default_factory=list)
     mesh: Mesh | None = None
+    # LoRA finetunes (model_cfg.adapter.rank > 0): the frozen base the
+    # adapter (state.params) was trained against — callers exporting or
+    # serving the adapter need exactly this pair. None for full training.
+    base_params: PyTree | None = None
 
 
 def _drop_yields(it: Iterator[np.ndarray], drops: set[int]) -> Iterator[np.ndarray]:
@@ -147,6 +151,35 @@ def make_eval_iterator(
     return make_host_iterator(train_cfg, model_cfg, seed_offset=500)
 
 
+def _placed_gspmd_params(params: PyTree, mesh: Mesh, rules) -> PyTree:
+    """Rule-table placement with GSPMD-normalized specs (degenerate axes
+    and trailing Nones dropped) so the step's output shardings equal its
+    input's — one executable, not two (train_step.state_shardings). The
+    ONE placement definition both init_state flavors share: full training
+    and the LoRA finetune's frozen base must place identically."""
+    specs = jax.tree.map(
+        lambda s: normalize_spec(s, mesh),
+        param_specs(params, rules),
+        is_leaf=lambda x: isinstance(x, P),
+    )
+    shardings = jax.tree.map(
+        lambda s: NamedSharding(mesh, s), specs, is_leaf=lambda x: isinstance(x, P)
+    )
+    return jax.device_put(params, shardings)
+
+
+def _guarded_optimizer(train_cfg: TrainConfig, opt_cfg: OptimConfig):
+    """The optimizer with the anomaly guard's device-side knobs threaded
+    in — shared so LoRA finetunes can never silently diverge from full
+    training's optimizer/guard behavior."""
+    guard_cfg = train_cfg.resilience.guard
+    return create_optimizer(
+        opt_cfg, total_steps=train_cfg.steps,
+        skip_nonfinite=guard_cfg.skip_nonfinite_updates,
+        max_consecutive_skips=guard_cfg.max_consecutive_skips,
+    )
+
+
 def init_state(
     model: GPT,
     model_cfg: ModelConfig,
@@ -176,25 +209,14 @@ def init_state(
             params, mesh.shape["pipe"], train_cfg.pp_virtual_stages
         )
         specs = pp_param_specs(params, rules)
-    else:
-        # GSPMD-normalized placement (degenerate axes and trailing Nones
-        # dropped) so the step's output shardings equal its input's — one
-        # executable, not two (train_step.state_shardings).
-        specs = jax.tree.map(
-            lambda s: normalize_spec(s, mesh),
-            param_specs(params, rules),
+        shardings = jax.tree.map(
+            lambda s: NamedSharding(mesh, s), specs,
             is_leaf=lambda x: isinstance(x, P),
         )
-    shardings = jax.tree.map(
-        lambda s: NamedSharding(mesh, s), specs, is_leaf=lambda x: isinstance(x, P)
-    )
-    params = jax.device_put(params, shardings)
-    guard_cfg = train_cfg.resilience.guard
-    tx = create_optimizer(
-        opt_cfg, total_steps=train_cfg.steps,
-        skip_nonfinite=guard_cfg.skip_nonfinite_updates,
-        max_consecutive_skips=guard_cfg.max_consecutive_skips,
-    )
+        params = jax.device_put(params, shardings)
+    else:
+        params = _placed_gspmd_params(params, mesh, rules)
+    tx = _guarded_optimizer(train_cfg, opt_cfg)
     # Eager tx.init on sharded params: zeros_like follows input sharding, so
     # the optimizer state lands correctly sharded without an _infer pass
     # (cf. /root/reference/train/train.py:44-52).
@@ -203,6 +225,39 @@ def init_state(
     # step's input signature is identical every call — half of the
     # double-compile fix (see train_step.state_shardings for the other).
     return canonicalize_state_placement(state, mesh)
+
+
+def init_adapter_state(
+    model: GPT,
+    model_cfg: ModelConfig,
+    train_cfg: TrainConfig,
+    opt_cfg: OptimConfig,
+    mesh: Mesh,
+    rules=DEFAULT_RULES,
+) -> tuple[TrainState, PyTree]:
+    """:func:`init_state`'s LoRA twin: init the full variable set once,
+    place the FROZEN base params exactly as init_state would (normalized
+    rule-table shardings), and build the TrainState — optimizer and all —
+    over the tiny "lora" subtree ONLY. Returns ``(state, base_params)``.
+
+    Because the state IS the adapter subtree, everything downstream that
+    operates on the state (sha256-verified checkpoints, stream sidecars,
+    guard rollback, SIGTERM graceful stop) operates on the adapter alone,
+    with zero adapter-specific code in the loop. Adapter factors are
+    replicated on the mesh (they are tiny — ``adapter_param_count``;
+    sharding them would buy nothing and cost a rule-table entry per
+    site)."""
+    dummy = jnp.ones((1, model_cfg.max_seq_len), dtype=jnp.int32)
+    init_rng = jax.random.PRNGKey(train_cfg.seed)
+    variables = jax.jit(
+        lambda rng, x: model.init({"params": rng, "dropout": rng}, x, train=False)
+    )(init_rng, dummy)
+    params, lora = variables["params"], variables["lora"]
+    params = _placed_gspmd_params(params, mesh, rules)
+    lora = jax.device_put(lora, NamedSharding(mesh, P()))
+    tx = _guarded_optimizer(train_cfg, opt_cfg)
+    state = TrainState.create(apply_fn=model.apply, params=lora, tx=tx)
+    return canonicalize_state_placement(state, mesh), params
 
 
 def train(
@@ -284,6 +339,15 @@ def _train(
         )
 
     model = GPT(model_cfg)
+    # LoRA finetune mode (dtc_tpu/adapters/): the TrainState is the
+    # adapter subtree, the base is a frozen step input. One flag here —
+    # the loop below is identical either way (that is the design).
+    lora_on = model_cfg.adapter.rank > 0
+    if lora_on and mesh.shape.get("pipe", 1) > 1:
+        raise ValueError(
+            "LoRA adapter training is not supported under pipeline "
+            "parallelism (pipe > 1); adapters compose with DP/TP/FSDP"
+        )
 
     # ------ resilience subsystem (SURVEY §5 failure-detection row) ------
     # Bus first: recovery actions fire from threads and layers that have no
@@ -315,7 +379,13 @@ def _train(
         )
 
     with mesh, nn.logical_axis_rules(rules):
-        state = init_state(model, model_cfg, train_cfg, opt_cfg, mesh, rules)
+        base_params = None
+        if lora_on:
+            state, base_params = init_adapter_state(
+                model, model_cfg, train_cfg, opt_cfg, mesh, rules
+            )
+        else:
+            state = init_state(model, model_cfg, train_cfg, opt_cfg, mesh, rules)
 
         # ------ checkpoint / resume ------
         ckpt = None
@@ -376,6 +446,7 @@ def _train(
             mesh, model=model, num_microbatches=train_cfg.pp_microbatches,
             rules=rules, pp_schedule=train_cfg.pp_schedule,
             pp_virtual=train_cfg.pp_virtual_stages, state=state,
+            base_params=base_params,
         )
 
         # Resume parity: the interrupted run consumed warmup_steps +
@@ -519,7 +590,7 @@ def _train(
         # chain whose position would restart at 0 (round-1 ADVICE).
         key = jax.random.key(train_cfg.seed, impl=train_cfg.prng_impl)
 
-        result = TrainResult(state=state, mesh=mesh)
+        result = TrainResult(state=state, mesh=mesh, base_params=base_params)
         # Step the result lists start after (losses[0] is result_base+1's);
         # only a rollback below the resume point ever moves it.
         result_base = start_step
@@ -611,7 +682,9 @@ def _train(
                 from dtc_tpu.data.prefetch import split_put
                 from dtc_tpu.train.train_step import create_eval_step
 
-                eval_fn = create_eval_step(mesh, model, rules=rules)
+                eval_fn = create_eval_step(
+                    mesh, model, rules=rules, base_params=base_params
+                )
                 spec = batch_spec(rules)
                 if eval_host_batches is not None:
                     # FineWeb: a REAL holdout — every eval_holdout_every-th

@@ -32,6 +32,26 @@ PyTree = Any
 MANIFEST_SKIP = {".tmp"}  # our own atomic-write temp suffix
 
 
+def _subtree_get(tree: PyTree, path: tuple[str, ...]) -> PyTree:
+    node = tree
+    for key in path:
+        node = node[key] if isinstance(node, dict) else getattr(node, key)
+    return node
+
+
+def _subtree_set(tree: PyTree, path: tuple[str, ...], value: PyTree) -> PyTree:
+    """Functionally replace the node at ``path`` (dicts copied per level;
+    flax structs / dataclasses updated via ``.replace``)."""
+    if not path:
+        return value
+    head, rest = path[0], path[1:]
+    if isinstance(tree, dict):
+        out = dict(tree)
+        out[head] = _subtree_set(tree[head], rest, value)
+        return out
+    return tree.replace(**{head: _subtree_set(getattr(tree, head), rest, value)})
+
+
 def _atomic_write_bytes(path: str, data: bytes) -> None:
     """tmp + fsync + os.replace: readers see the old file or the new file,
     never a truncated one — even across a preemption mid-write."""
@@ -109,8 +129,21 @@ class CheckpointManager:
         return os.path.join(self._dir, f"manifest_{step}.json")
 
     # ---- save / verify ---------------------------------------------------
-    def save(self, step: int, state: PyTree) -> None:
+    def save(self, step: int, state: PyTree,
+             subtree: tuple[str, ...] | None = None) -> None:
+        """Persist ``state`` (or, with ``subtree``, ONLY the node at that
+        key path) as the step's checkpoint.
+
+        ``subtree`` is the adapter-checkpoint contract (dtc_tpu/adapters/):
+        an adapter-only checkpoint must neither write nor later require
+        the frozen base params — extraction happens HERE, before Orbax
+        sees the tree, so nothing else can leak in. Restore with the same
+        ``subtree`` against any freshly-initialized enclosing state
+        (tests/test_adapters.py pins this)."""
         import orbax.checkpoint as ocp
+
+        if subtree is not None:
+            state = _subtree_get(state, tuple(subtree))
 
         if step in self._mgr.all_steps():
             # Replaying past a rollback (or a resume that fell back below
@@ -217,13 +250,19 @@ class CheckpointManager:
         return None
 
     # ---- restore ---------------------------------------------------------
-    def restore(self, state_like: PyTree, step: int | None = None) -> PyTree:
+    def restore(self, state_like: PyTree, step: int | None = None,
+                subtree: tuple[str, ...] | None = None) -> PyTree:
         """Restore into the sharding/structure of ``state_like``.
 
         With ``step=None``, restores the newest step that BOTH passes
         manifest verification AND actually restores — an unverifiable
         legacy step that turns out corrupt is caught by Orbax's own raise
         and the next older intact step is tried.
+
+        With ``subtree`` (a checkpoint written by ``save(..., subtree=…)``),
+        only that node is read from disk and grafted back into
+        ``state_like`` — the rest of the tree (e.g. a freshly-initialized
+        frozen base) passes through untouched, never required on disk.
 
         Every jax.Array leaf gets an explicit NamedSharding on the current
         mesh. Leaves created eagerly outside jit (e.g. scalar AdamW step
@@ -234,13 +273,25 @@ class CheckpointManager:
         (``P()``) on the mesh inferred from the sharded leaves instead.
         """
         if step is not None:
+            if subtree is not None:
+                piece = self._restore_step(
+                    step, _subtree_get(state_like, tuple(subtree))
+                )
+                return _subtree_set(state_like, tuple(subtree), piece)
             return self._restore_step(step, state_like)
-        state, _ = self.restore_latest(state_like)
+        state, _ = self.restore_latest(state_like, subtree=subtree)
         return state
 
-    def restore_latest(self, state_like: PyTree) -> tuple[PyTree, int]:
+    def restore_latest(self, state_like: PyTree,
+                       subtree: tuple[str, ...] | None = None
+                       ) -> tuple[PyTree, int]:
         """Restore the newest intact step; returns ``(state, step)`` so
-        callers (resume, rollback) know which step they actually got."""
+        callers (resume, rollback) know which step they actually got.
+        ``subtree``: see :meth:`restore`."""
+        if subtree is not None:
+            piece_like = _subtree_get(state_like, tuple(subtree))
+            piece, step = self.restore_latest(piece_like)
+            return _subtree_set(state_like, tuple(subtree), piece), step
         steps = self.all_steps()
         if not steps:
             raise FileNotFoundError(f"no checkpoint under {self._dir}")

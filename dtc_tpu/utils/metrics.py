@@ -10,7 +10,7 @@ from __future__ import annotations
 import jax
 
 from dtc_tpu.config.schema import ModelConfig
-from dtc_tpu.models.gpt import param_count
+from dtc_tpu.models.gpt import adapter_param_count, param_count
 
 #: Peak dense (bf16) FLOP/s per chip by device kind substring.
 _PEAK_FLOPS = (
@@ -142,12 +142,18 @@ def decode_step_flops(cfg: ModelConfig, batch: int, cache_len: int) -> float:
     Decode FLOPs are tiny (the flagship's ~0.13 GF/token is <0.001% of a
     v5e-second); the step is bandwidth-bound, which is why the roofline
     below is a byte model, not a FLOP model.
+
+    With an active adapter (``cfg.adapter.rank > 0``) the per-token
+    low-rank term rides along — 2 FLOPs per adapter param per token, the
+    same convention as the dense 2·N term — so LoRA-serving roofline rows
+    stay honest about the extra work every token pays.
     """
     n = param_count(cfg)
     n_matmul = n - cfg.padded_vocab_size * cfg.d_model - cfg.max_seq_len * cfg.d_model
     dense = 2.0 * n_matmul * batch
     attn = 4.0 * cfg.n_layers * batch * cache_len * cfg.d_model
-    return dense + attn
+    lora = 2.0 * adapter_param_count(cfg) * batch
+    return dense + attn + lora
 
 
 def decode_step_bytes(
@@ -174,6 +180,10 @@ def decode_step_bytes(
       logits row — an estimate (XLA fuses some of these into neighbors),
       kept structural so the floor is conservative (higher floor = honest
       pct-of-roofline).
+    - ``lora`` (adapter-enabled models only): each batch row reads ITS
+      OWN gathered factors per step — unlike the base weights, the
+      per-tenant term scales with batch and cannot amortize across rows,
+      which is the multi-tenant design's bandwidth price.
 
     Returns the components plus ``total``.
     """
@@ -193,12 +203,14 @@ def decode_step_bytes(
         cfg.n_layers * (10.0 * d + 2.0 * ff) * cbytes * batch
         + cfg.padded_vocab_size * cbytes * batch
     )
-    total = weights + kv_read + kv_write + activations
+    lora = float(adapter_param_count(cfg)) * pbytes * batch
+    total = weights + kv_read + kv_write + activations + lora
     return {
         "weights": weights,
         "kv_read": kv_read,
         "kv_write": kv_write,
         "activations": activations,
+        "lora": lora,
         "total": total,
     }
 

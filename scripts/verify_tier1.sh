@@ -13,16 +13,19 @@
 timeout -k 10 120 env JAX_PLATFORMS=cpu python -m pytest --collect-only -q -p no:cacheprovider \
   tests/test_moe.py tests/test_collectives_hlo.py \
   tests/test_generate.py tests/test_metrics.py tests/test_analysis.py \
-  tests/test_serve.py tests/test_trace.py tests/test_devprof.py > /dev/null || {
-    echo "tier-1 pre-gate: MoE/HLO/decode/analysis/serve/trace/devprof test collection failed" >&2; exit 1; }
+  tests/test_serve.py tests/test_trace.py tests/test_devprof.py \
+  tests/test_adapters.py > /dev/null || {
+    echo "tier-1 pre-gate: MoE/HLO/decode/analysis/serve/trace/devprof/adapters test collection failed" >&2; exit 1; }
 # Pre-gate 2 (ISSUE 5 + 6): the graph audit — lower/compile the
 # dp/tp/fsdp/ep train steps (8-virtual-device CPU mesh), the greedy decode
 # scan, AND the serving (continuous-batching) decode step; run the rule
 # engine (collective census, donation, dtype, host-sync lint, recompile)
 # and gate on ALL committed baselines under dtc_tpu/analysis/baselines/.
-# The serve entry's recompile fingerprint ADMITS a request between its two
-# measured executions, so its cold==1/steady==0 baseline proves admission
-# at fixed slots never recompiles the decode step. ~2-3 min on this
+# BOTH serve entries (multi-tenant lora + adapter-free) carry recompile
+# fingerprints that ADMIT a request — and, for the lora flavor, LOAD an
+# adapter — between the two measured executions, so their
+# cold==1/steady==0 baselines prove admission and tenant churn at fixed
+# slots never recompile the decode step. ~2-3 min on this
 # 1-core host; runs anywhere (JAX_PLATFORMS=cpu, no accelerator). On an
 # INTENDED graph change: re-bless with
 #   python scripts/audit_graph.py --modes dp,tp,fsdp,ep --decode --serve --write-baseline
@@ -56,4 +59,13 @@ timeout -k 10 300 env JAX_PLATFORMS=cpu python scripts/trace_smoke.py || {
 # op events at all. ~1-2 min.
 timeout -k 10 300 env JAX_PLATFORMS=cpu python scripts/devprof_smoke.py || {
     echo "tier-1 pre-gate: devprof smoke failed" >&2; exit 1; }
+# Pre-gate 6 (ISSUE 10): adapter-loop smoke — two LoRA adapters finetuned
+# 3 steps each through the real trainer (adapter-only TrainState, shared
+# frozen base), exported + reloaded via the adapter-artifact round-trip,
+# then two tenants + one base request co-scheduled in ONE in-flight batch
+# on the serving engine, every output asserted token-for-token identical
+# to solo generate() with the matching adapter, with zero steady-state
+# recompiles across the mixed-tenant admissions. ~1-2 min.
+timeout -k 10 300 env JAX_PLATFORMS=cpu python scripts/adapter_smoke.py || {
+    echo "tier-1 pre-gate: adapter-loop smoke failed" >&2; exit 1; }
 set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc

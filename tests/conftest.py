@@ -55,6 +55,8 @@ _QUICK_EXCLUDE_FILES = {
     "test_multihost.py",
     "test_resilience.py",
     "test_checkpoint.py",
+    # Drives full chaos finetune + mixed-tenant chaos serving runs.
+    "test_adapters.py",
 }
 
 

@@ -1,0 +1,675 @@
+"""Multi-tenant LoRA adapters (ISSUE 10): injection numerics, adapter-only
+training/checkpointing, and batched multi-tenant serving.
+
+Anchor invariants:
+
+- rank 0 is BITWISE off (no "lora" collection, logits byte-identical to a
+  pre-adapter model);
+- the runtime adapter path (base matmul + low-rank delta) decodes
+  token-exactly against the offline merged-weights oracle
+  (``W' = W + (alpha/r)·A·B`` through a plain model);
+- training moves ONLY the adapter subtree (the frozen base is bitwise
+  untouched), and a chaos-injected finetune is bit-identical to a clean
+  one — the PR 2 acceptance bar, re-proven for the adapter TrainState;
+- K co-scheduled tenants in ONE serving batch each decode token-identical
+  to their solo runs, recompile-free across adapter loads + admissions.
+"""
+
+import dataclasses
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from dtc_tpu.adapters import (
+    AdapterStore,
+    gather_slot_lora,
+    init_lora,
+    init_lora_stack,
+    load_adapter_file,
+    merge_lora,
+    save_adapter,
+)
+from dtc_tpu.config.schema import (
+    AdapterConfig,
+    ChaosConfig,
+    ModelConfig,
+    ResilienceConfig,
+    ServeConfig,
+)
+from dtc_tpu.generate import generate
+from dtc_tpu.models.gpt import GPT, adapter_param_count, param_count
+from dtc_tpu.serve import (
+    AdapterStoreFullError,
+    Request,
+    RequestState,
+    ServingEngine,
+    UnknownAdapterError,
+)
+
+VOCAB = 97
+
+_BASE_KW = dict(
+    vocab_size=VOCAB, d_model=64, n_layers=4, n_heads=4, d_ff=128,
+    max_seq_len=32, dropout=0.0, param_dtype="float32",
+    compute_dtype="float32", attention="dense",
+)
+
+
+def _rand_lora(model, seed, scale=0.05):
+    """Random NONZERO factors (init_lora's B is zero by design — fine for
+    shapes, useless for numerics tests)."""
+    base = init_lora(model, 0)
+    leaves, td = jax.tree.flatten(base)
+    keys = jax.random.split(jax.random.PRNGKey(seed), len(leaves))
+    return jax.tree.unflatten(td, [
+        scale * jax.random.normal(k, l.shape, l.dtype)
+        for k, l in zip(keys, leaves)
+    ])
+
+
+@pytest.fixture(scope="module")
+def lora_setup():
+    """One adapter-enabled tiny GPT + its plain twin + base params + two
+    nonzero factor trees, shared by every test in the module."""
+    cfg = ModelConfig(**_BASE_KW, adapter=AdapterConfig(rank=4, alpha=8.0))
+    plain_cfg = ModelConfig(**_BASE_KW)
+    model, plain = GPT(cfg), GPT(plain_cfg)
+    variables = model.init(
+        {"params": jax.random.PRNGKey(0)}, jnp.ones((1, 1), jnp.int32),
+        train=False,
+    )
+    return {
+        "cfg": cfg, "plain_cfg": plain_cfg, "model": model, "plain": plain,
+        "params": variables["params"], "lora0": variables["lora"],
+        "lA": _rand_lora(model, 11), "lB": _rand_lora(model, 22),
+    }
+
+
+def _prompts(seed, sizes):
+    rng = np.random.RandomState(seed)
+    return [rng.randint(0, VOCAB, size=n).tolist() for n in sizes]
+
+
+# ---------------------------------------------------------------------------
+# config + host-side units
+# ---------------------------------------------------------------------------
+
+def test_adapter_config_validation():
+    with pytest.raises(ValueError):
+        AdapterConfig(rank=-1)
+    with pytest.raises(ValueError):
+        AdapterConfig(rank=4, alpha=0.0)
+    with pytest.raises(ValueError):
+        AdapterConfig(rank=4, dropout=1.0)
+    with pytest.raises(ValueError):
+        AdapterConfig(rank=4, target_modules=("q_proj", "wte"))
+    with pytest.raises(ValueError):
+        AdapterConfig(rank=4, target_modules=())
+    assert AdapterConfig(rank=8, alpha=16.0).scale == 2.0
+    assert AdapterConfig().scale == 0.0
+    with pytest.raises(ValueError):
+        ServeConfig(max_adapters=1)
+    # YAML hands over lists; the config must coerce to tuple so the model
+    # config stays hashable (generate() jits with the model static).
+    cfg = AdapterConfig(rank=2, target_modules=["q_proj", "fc1"])
+    assert cfg.target_modules == ("q_proj", "fc1")
+    hash(ModelConfig(**_BASE_KW, adapter=cfg))
+    # MoE has no dense fc1/fc2: an adapter targeting only them would have
+    # ZERO sites — rejected at config time, not as a downstream KeyError.
+    with pytest.raises(ValueError, match="attention"):
+        ModelConfig(
+            **_BASE_KW, moe_experts=4,
+            adapter=AdapterConfig(rank=4, target_modules=("fc1", "fc2")),
+        )
+    # Attention targets + MoE is fine.
+    ModelConfig(
+        **_BASE_KW, moe_experts=4,
+        adapter=AdapterConfig(rank=4, target_modules=("q_proj",)),
+    )
+
+
+def test_adapter_store_lru_refcounts_and_typed_full():
+    s = AdapterStore(capacity=3)  # slot 0 base + 2 tenant slots
+    slot_a, ev = s.register("a")
+    assert slot_a == 1 and ev is None
+    slot_b, ev = s.register("b")
+    assert slot_b == 2 and ev is None
+    # Re-register = same slot (hot update), no eviction.
+    assert s.register("a") == (1, None)
+    # "b" is now LRU; a third tenant evicts it.
+    slot_c, ev = s.register("c")
+    assert slot_c == 2 and ev == "b"
+    assert s.slot_of("b") is None and s.slot_of("c") == 2
+    # Refcounts pin residency: with both tenants held, the store is full.
+    s.acquire("a"), s.acquire("c")
+    with pytest.raises(AdapterStoreFullError):
+        s.register("d")
+    # Hot-updating a PINNED tenant's factors would fork its in-flight
+    # decode from the KV already computed — caller bug, ValueError.
+    with pytest.raises(ValueError, match="in-flight"):
+        s.register("a")
+    s.release("c")
+    slot_d, ev = s.register("d")
+    assert slot_d == 2 and ev == "c"
+    with pytest.raises(ValueError):
+        s.register("base")
+    with pytest.raises(KeyError):
+        s.acquire("ghost")
+
+
+def test_adapter_param_count_and_collection_shapes(lora_setup):
+    cfg, lora0 = lora_setup["cfg"], lora_setup["lora0"]
+    n = sum(l.size for l in jax.tree.leaves(lora0))
+    assert n == adapter_param_count(cfg)
+    # Counted separately: the base count is the pre-adapter count.
+    assert param_count(cfg) == param_count(lora_setup["plain_cfg"])
+    # Stacked per layer: every factor leaf leads with the layers axis.
+    for leaf in jax.tree.leaves(lora0):
+        assert leaf.shape[0] == cfg.n_layers
+    # Disabled / attention-only accounting.
+    assert adapter_param_count(lora_setup["plain_cfg"]) == 0
+    attn_only = dataclasses.replace(
+        cfg, adapter=AdapterConfig(rank=4, target_modules=("q_proj",))
+    )
+    assert adapter_param_count(attn_only) == cfg.n_layers * 4 * 128
+
+
+def test_decode_metrics_gain_lora_terms(lora_setup):
+    from dtc_tpu.utils.metrics import decode_step_bytes, decode_step_flops
+
+    cfg, plain_cfg = lora_setup["cfg"], lora_setup["plain_cfg"]
+    b, cache_len = 8, 16
+    n_ad = adapter_param_count(cfg)
+    assert decode_step_flops(cfg, b, cache_len) == pytest.approx(
+        decode_step_flops(plain_cfg, b, cache_len) + 2.0 * n_ad * b
+    )
+    with_l = decode_step_bytes(cfg, b, cache_len)
+    without = decode_step_bytes(plain_cfg, b, cache_len)
+    assert with_l["lora"] == n_ad * 4 * b  # fp32 factors, per-row reads
+    assert without["lora"] == 0.0
+    assert with_l["total"] == pytest.approx(without["total"] + n_ad * 4 * b)
+    # The per-tenant term scales with batch (no cross-row amortization).
+    assert decode_step_bytes(cfg, 64, cache_len)["lora"] == n_ad * 4 * 64
+
+
+# ---------------------------------------------------------------------------
+# injection numerics
+# ---------------------------------------------------------------------------
+
+def test_rank0_is_bitwise_pristine(lora_setup):
+    """A rank-0 adapter config creates no collection and changes no byte
+    of the computation — the compiled model IS the pre-adapter model."""
+    plain = lora_setup["plain"]
+    r0 = GPT(ModelConfig(**_BASE_KW, adapter=AdapterConfig(rank=0)))
+    x = jnp.asarray(_prompts(0, (8,))[0], jnp.int32)[None]
+    k = jax.random.PRNGKey(0)
+    vp = plain.init({"params": k}, x, train=False)
+    v0 = r0.init({"params": k}, x, train=False)
+    assert "lora" not in v0
+    for a, b in zip(jax.tree.leaves(vp), jax.tree.leaves(v0)):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+    lp = np.asarray(plain.apply(vp, x, train=False))
+    l0 = np.asarray(r0.apply(v0, x, train=False))
+    assert np.array_equal(lp, l0), "rank-0 adapter config is not bitwise off"
+
+
+def test_zero_init_and_missing_collection_equal_base(lora_setup):
+    """B initializes to zero => the injected model starts AT the base; and
+    applying an adapter-enabled model WITHOUT a lora collection is base
+    semantics (generate/eval on bare base params just works)."""
+    s = lora_setup
+    x = jnp.asarray(_prompts(1, (9,))[0], jnp.int32)[None]
+    base_logits = np.asarray(s["plain"].apply(
+        {"params": s["params"]}, x, train=False
+    ))
+    zero_logits = np.asarray(s["model"].apply(
+        {"params": s["params"], "lora": s["lora0"]}, x, train=False
+    ))
+    nolora_logits = np.asarray(s["model"].apply(
+        {"params": s["params"]}, x, train=False
+    ))
+    assert np.array_equal(base_logits, zero_logits)
+    assert np.array_equal(base_logits, nolora_logits)
+
+
+def test_merged_weights_oracle_token_exact(lora_setup):
+    """The runtime adapter path vs base weights merged OFFLINE
+    (W' = W + scale·A·B applied through the PLAIN model): greedy decode
+    must agree token-for-token."""
+    s = lora_setup
+    merged_params = merge_lora(s["params"], s["lA"], s["cfg"])
+    changed_any = False
+    for i, prompt in enumerate(_prompts(2, (6, 9))):
+        p = jnp.asarray(prompt, jnp.int32)[None]
+        runtime = np.asarray(generate(
+            s["model"], s["params"], p, 8, lora=s["lA"]
+        ))
+        merged = np.asarray(generate(s["plain"], merged_params, p, 8))
+        assert (runtime == merged).all(), f"prompt {i}: {runtime} vs {merged}"
+        base = np.asarray(generate(s["plain"], s["params"], p, 8))
+        changed_any |= not (runtime == base).all()
+    # The adapter is no-op-proof: on at least one prompt it moves the
+    # greedy argmax away from the base model's (per-prompt agreement is
+    # legitimate at small delta scale).
+    assert changed_any
+
+
+def test_gathered_stack_matches_per_tenant_solo(lora_setup):
+    """The serving primitive: a (n_adapters, ...) stack gathered per-row
+    must produce, row by row, the same logits as per-tenant solo applies
+    (row factors (B, in, r) vs shared factors (in, r))."""
+    from dtc_tpu.generate import decode_step, init_cache
+
+    s = lora_setup
+    stack = init_lora_stack(s["model"], 3)
+    stack = jax.tree.map(lambda st, l: st.at[1].set(l), stack, s["lA"])
+    stack = jax.tree.map(lambda st, l: st.at[2].set(l), stack, s["lB"])
+    prompt = jnp.asarray(_prompts(3, (7,))[0], jnp.int32)[None]
+    batch = jnp.concatenate([prompt, prompt, prompt], axis=0)
+    gathered = gather_slot_lora(stack, jnp.asarray([0, 1, 2], jnp.int32))
+    _, logits = decode_step(
+        s["model"], s["params"], init_cache(s["model"], 3), batch, gathered
+    )
+    solos = [
+        s["plain"].apply({"params": s["params"]}, prompt, train=False),
+        s["model"].apply(
+            {"params": s["params"], "lora": s["lA"]}, prompt, train=False
+        ),
+        s["model"].apply(
+            {"params": s["params"], "lora": s["lB"]}, prompt, train=False
+        ),
+    ]
+    for row, solo in enumerate(solos):
+        np.testing.assert_allclose(
+            np.asarray(logits[row]), np.asarray(solo[0]), atol=1e-5
+        )
+
+
+def test_adapter_artifact_roundtrip(lora_setup, tmp_path):
+    s = lora_setup
+    path = str(tmp_path / "t.npz")
+    save_adapter(path, s["lA"], {"rank": 4, "name": "t"})
+    tree, meta = load_adapter_file(path, like=s["lA"])
+    assert meta["rank"] == 4 and meta["name"] == "t"
+    for a, b in zip(jax.tree.leaves(tree), jax.tree.leaves(s["lA"])):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+    assert not [p for p in os.listdir(tmp_path) if p.endswith(".tmp")]
+    # Wrong-shape factors are rejected loudly by the engine-side check.
+    from dtc_tpu.adapters import validate_lora_tree
+
+    stack = init_lora_stack(s["model"], 2)
+    bad = jax.tree.map(lambda l: l[..., :-1], s["lA"])
+    with pytest.raises(ValueError):
+        validate_lora_tree(stack, bad)
+
+
+# ---------------------------------------------------------------------------
+# training leg
+# ---------------------------------------------------------------------------
+
+def test_lora_train_step_updates_only_adapter(lora_setup, train_cfg_factory,
+                                              opt_cfg):
+    """Two adapter train steps: the optimizer state and gradients live on
+    the lora subtree alone; the frozen base is bitwise untouched."""
+    from flax import linen as nn
+
+    from dtc_tpu.parallel.mesh import mesh_from_config
+    from dtc_tpu.parallel.sharding import DEFAULT_RULES
+    from dtc_tpu.train.train_step import Batch, create_train_step
+    from dtc_tpu.train.trainer import init_adapter_state
+
+    s = lora_setup
+    tc = train_cfg_factory("dp")
+    mesh = mesh_from_config("dp", tc.mesh)
+    with mesh, nn.logical_axis_rules(DEFAULT_RULES):
+        state, base = init_adapter_state(
+            s["model"], s["cfg"], tc, opt_cfg, mesh
+        )
+        base_before = jax.tree.map(lambda a: np.asarray(a).copy(), base)
+        lora_before = jax.tree.map(
+            lambda a: np.asarray(a).copy(), state.params
+        )
+        step = create_train_step(
+            mesh, model=s["model"], state=state, base_params=base
+        )
+        x = jnp.zeros((tc.batch, s["cfg"].max_seq_len), jnp.int32)
+        for i in range(2):
+            state, loss = step(state, Batch(x=x, y=x), jax.random.PRNGKey(i))
+        assert np.isfinite(float(loss))
+    # Optimizer state mirrors the lora tree (AdamW moments per lora leaf).
+    assert (
+        jax.tree.structure(state.params)
+        == jax.tree.structure(state.opt_state[1][0].mu)
+    )
+    moved = [
+        not np.array_equal(np.asarray(a), b)
+        for a, b in zip(jax.tree.leaves(state.params),
+                        jax.tree.leaves(lora_before))
+    ]
+    assert all(moved), "some adapter factors never received an update"
+    for a, b in zip(jax.tree.leaves(base), jax.tree.leaves(base_before)):
+        assert np.array_equal(np.asarray(a), b), "frozen base moved"
+
+
+def test_adapter_checkpoint_subtree_restores_against_fresh_base(
+    lora_setup, tmp_path
+):
+    """The CheckpointManager subtree contract: an adapter-only checkpoint
+    written with ``subtree=("lora",)`` restores into a FRESHLY-initialized
+    enclosing state — the frozen base is neither written to disk nor
+    required by restore (restoring the full tree from it fails)."""
+    from dtc_tpu.utils.checkpoint import CheckpointManager
+
+    s = lora_setup
+    full = {"params": s["params"], "lora": s["lA"]}
+    mgr = CheckpointManager(str(tmp_path))
+    mgr.save(3, full, subtree=("lora",))
+    # A fresh base + zeroed adapter slot stands in for a new process.
+    fresh = {
+        "params": s["params"],
+        "lora": jax.tree.map(jnp.zeros_like, s["lA"]),
+    }
+    restored, step = mgr.restore_latest(fresh, subtree=("lora",))
+    assert step == 3
+    for a, b in zip(jax.tree.leaves(restored["lora"]),
+                    jax.tree.leaves(s["lA"])):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+    assert restored["params"] is fresh["params"]  # untouched passthrough
+    # The checkpoint holds ONLY the adapter: a full-tree restore fails.
+    with pytest.raises(FileNotFoundError):
+        mgr.restore_latest(full)
+    mgr.close()
+
+
+def test_chaos_lora_finetune_bit_identical(train_cfg_factory, opt_cfg,
+                                           tmp_path):
+    """THE training-leg acceptance (ISSUE 10): a chaos-injected LoRA
+    finetune (NaN-poisoned adapter at step 3 -> guard rollback to the
+    adapter-only verified checkpoint -> stream re-seek -> replay) produces
+    losses IDENTICAL to an uninjected finetune — the PR 2 guarantee,
+    re-proven with the TrainState being the adapter subtree."""
+    from dtc_tpu.train.trainer import train
+
+    model_cfg = ModelConfig(**{**_BASE_KW, "dropout": 0.1},
+                            adapter=AdapterConfig(rank=4, alpha=8.0))
+    base = dict(steps=5, warmup_steps=1, log_every=1, checkpoint_every=2)
+    clean = train(
+        train_cfg_factory(
+            "dp", output_dir=str(tmp_path / "clean"),
+            checkpoint_dir=str(tmp_path / "clean_ckpt"), **base,
+        ),
+        model_cfg, opt_cfg,
+    )
+    chaotic = train(
+        dataclasses.replace(
+            train_cfg_factory(
+                "dp", output_dir=str(tmp_path / "chaos"),
+                checkpoint_dir=str(tmp_path / "chaos_ckpt"), **base,
+            ),
+            resilience=ResilienceConfig(
+                chaos=ChaosConfig(enabled=True, nan_at_step=3)
+            ),
+        ),
+        model_cfg, opt_cfg,
+    )
+    assert len(chaotic.losses) == 5
+    np.testing.assert_allclose(chaotic.losses, clean.losses, rtol=1e-6)
+    # The frozen base is identical across runs (it is seed-derived and
+    # never updated, checkpointed, or rolled back).
+    for a, b in zip(jax.tree.leaves(clean.base_params),
+                    jax.tree.leaves(chaotic.base_params)):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_lora_under_pp_raises(train_cfg_factory, opt_cfg):
+    from dtc_tpu.train.trainer import train
+
+    model_cfg = ModelConfig(**_BASE_KW, adapter=AdapterConfig(rank=2))
+    with pytest.raises(ValueError, match="pipeline"):
+        train(train_cfg_factory("pp", pp_microbatches=2), model_cfg, opt_cfg)
+
+
+# ---------------------------------------------------------------------------
+# serving leg
+# ---------------------------------------------------------------------------
+
+def _engine(s, **kw):
+    cfg = dict(slots=3, page_size=4, queue_depth=8, max_new_tokens=6,
+               prefill_bucket=8, max_adapters=4)
+    cfg.update(kw)
+    return ServingEngine(s["model"], s["params"], ServeConfig(**cfg))
+
+
+def test_mixed_batch_tenants_token_identical_to_solo(lora_setup):
+    """K=3 co-scheduled tenants (two adapters + base) in ONE in-flight
+    batch: each completes token-identical to its solo run."""
+    s = lora_setup
+    prompts = _prompts(4, (5, 7, 6))
+    refs = [
+        np.asarray(generate(
+            s["model"], s["params"],
+            jnp.asarray(prompts[0], jnp.int32)[None], 6, lora=s["lA"],
+        ))[0].tolist(),
+        np.asarray(generate(
+            s["model"], s["params"],
+            jnp.asarray(prompts[1], jnp.int32)[None], 6, lora=s["lB"],
+        ))[0].tolist(),
+        np.asarray(generate(
+            s["model"], s["params"],
+            jnp.asarray(prompts[2], jnp.int32)[None], 6,
+        ))[0].tolist(),
+    ]
+    eng = _engine(s)
+    eng.load_adapter("tA", s["lA"])
+    eng.load_adapter("tB", s["lB"])
+    eng.submit(Request(rid="a", prompt=prompts[0], max_new_tokens=6,
+                       adapter="tA"))
+    eng.submit(Request(rid="b", prompt=prompts[1], max_new_tokens=6,
+                       adapter="tB"))
+    eng.submit(Request(rid="c", prompt=prompts[2], max_new_tokens=6))
+    res = eng.run(max_steps=200)
+    for rid, ref in zip("abc", refs):
+        assert res[rid].state is RequestState.DONE
+        assert res[rid].tokens == ref, rid
+    # All three decoded together at least once (continuous batching).
+    assert eng.reg.histogram("serve_batch_occupancy").max == 3
+    # Per-tenant SLO surface exists.
+    snap = eng.reg.snapshot()
+    for tenant in ("tA", "tB", "base"):
+        assert f"serve_ttft_s.{tenant}" in snap
+    # serve_request events carry the adapter name.
+    assert res["a"].adapter == "tA" and res["c"].adapter is None
+
+
+def test_unknown_adapter_and_store_full_typed(lora_setup):
+    s = lora_setup
+    eng = _engine(s, max_adapters=2)  # base + ONE tenant slot
+    with pytest.raises(UnknownAdapterError):
+        eng.submit(Request(rid="x", prompt=[1, 2], max_new_tokens=2,
+                           adapter="ghost"))
+    eng.load_adapter("tA", s["lA"])
+    eng.submit(Request(rid="a", prompt=[1, 2, 3], max_new_tokens=6,
+                       adapter="tA"))
+    # "tA" has an in-flight request: nothing is evictable.
+    with pytest.raises(AdapterStoreFullError):
+        eng.load_adapter("tB", s["lB"])
+    eng.run(max_steps=100)
+    # Terminal => unpinned => LRU eviction frees the slot.
+    eng.load_adapter("tB", s["lB"])
+    assert eng.adapter_store.slot_of("tA") is None
+    assert eng.reg.snapshot()["adapter_evictions"] == 1
+    # A lora-free engine rejects adapter requests and loads, typed.
+    plain_eng = ServingEngine(s["plain"], s["params"], ServeConfig(
+        slots=1, page_size=4, queue_depth=4, max_new_tokens=4,
+        prefill_bucket=8,
+    ))
+    with pytest.raises(UnknownAdapterError):
+        plain_eng.submit(Request(rid="y", prompt=[1], max_new_tokens=2,
+                                 adapter="tA"))
+    with pytest.raises(ValueError, match="lora-free"):
+        plain_eng.load_adapter("tA", s["lA"])
+
+
+def test_prefix_store_scoped_per_adapter(lora_setup):
+    """The same system-prompt prefix under two tenants must NOT share KV
+    (different adapters => different bytes): two store builds, and each
+    tenant's own repeat admission hits its entry."""
+    s = lora_setup
+    rng = np.random.RandomState(9)
+    prefix = rng.randint(0, VOCAB, size=6).tolist()
+    tails = [rng.randint(0, VOCAB, size=3).tolist() for _ in range(4)]
+    eng = _engine(s, slots=2)
+    eng.load_adapter("tA", s["lA"])
+    eng.load_adapter("tB", s["lB"])
+    for i, (tail, ad) in enumerate(zip(tails, ("tA", "tA", "tB", "tB"))):
+        eng.submit(Request(
+            rid=f"r{i}", prompt=prefix + tail, max_new_tokens=4,
+            adapter=ad, shared_prefix_len=len(prefix),
+        ))
+    res = eng.run(max_steps=300)
+    snap = eng.reg.snapshot()
+    assert snap["serve_prefix_builds"] == 2  # one per tenant, not one total
+    assert snap["serve_prefix_hits"] == 2    # each tenant's second request
+    # And the outputs are still per-tenant exact.
+    for i, (tail, lora) in enumerate(zip(tails, (s["lA"], s["lA"], s["lB"],
+                                                 s["lB"]))):
+        ref = np.asarray(generate(
+            s["model"], s["params"],
+            jnp.asarray(prefix + tail, jnp.int32)[None], 4, lora=lora,
+        ))[0].tolist()
+        assert res[f"r{i}"].tokens == ref, i
+
+
+def test_adapter_reload_invalidates_stale_prefix_kv(lora_setup):
+    """A hot adapter update (reload under the same name) must drop prefix
+    KV built under the OLD factors — a stale hit would decode the suffix
+    under new factors against old-prefix bytes, silently wrong."""
+    s = lora_setup
+    rng = np.random.RandomState(13)
+    prefix = rng.randint(0, VOCAB, size=6).tolist()
+    tail = rng.randint(0, VOCAB, size=3).tolist()
+    eng = _engine(s, slots=2)
+    eng.load_adapter("t", s["lA"])
+    eng.submit(Request(rid="r1", prompt=prefix + tail, max_new_tokens=4,
+                       adapter="t", shared_prefix_len=len(prefix)))
+    eng.run(max_steps=100)
+    eng.load_adapter("t", s["lB"])  # hot update: lA -> lB
+    eng.submit(Request(rid="r2", prompt=prefix + tail, max_new_tokens=4,
+                       adapter="t", shared_prefix_len=len(prefix)))
+    res = eng.run(max_steps=100)
+    ref = np.asarray(generate(
+        s["model"], s["params"], jnp.asarray(prefix + tail, jnp.int32)[None],
+        4, lora=s["lB"],
+    ))[0].tolist()
+    assert res["r2"].tokens == ref, "stale prefix KV survived the reload"
+    snap = eng.reg.snapshot()
+    assert snap["serve_prefix_builds"] == 2  # rebuilt after the reload
+    # Hot update while the tenant is in flight is refused, typed.
+    eng.submit(Request(rid="r3", prompt=prefix + tail, max_new_tokens=6,
+                       adapter="t", shared_prefix_len=len(prefix)))
+    with pytest.raises(ValueError, match="in-flight"):
+        eng.load_adapter("t", s["lA"])
+    eng.run(max_steps=100)
+    # Store-LRU eviction retires the tenant's per-name instruments.
+    eng.load_adapter("u1", s["lA"])
+    eng.load_adapter("u2", s["lB"])
+    eng.load_adapter("u3", s["lA"])  # evicts "t" (max_adapters=4: 3 slots)
+    assert eng.adapter_store.slot_of("t") is None
+    snap = eng.reg.snapshot()
+    assert "serve_ttft_s.t" not in snap
+    assert "serve_ms_per_token.t" not in snap
+
+
+def test_mixed_tenant_serving_never_recompiles(lora_setup):
+    """The serve_decode audit invariant, live: adapter load + mixed-tenant
+    admission + slot churn reuse ONE decode executable."""
+    from dtc_tpu.obs.stepclock import CompileWatcher
+
+    s = lora_setup
+    prompts = _prompts(5, (5, 6, 4))
+    eng = _engine(s)
+    eng.load_adapter("tA", s["lA"])
+    eng.submit(Request(rid="warm", prompt=[1, 2, 3], max_new_tokens=3,
+                       adapter="tA"))
+    eng.run(max_steps=30)
+    w = CompileWatcher().activate()
+    try:
+        w.drain()
+        eng.load_adapter("tB", s["lB"])  # hot load inside the window
+        eng.submit(Request(rid="a", prompt=prompts[0], max_new_tokens=6,
+                           adapter="tB"))
+        eng.step()
+        eng.submit(Request(rid="b", prompt=prompts[1], max_new_tokens=6))
+        eng.step()  # mixed tenant+base batch mid-flight
+        eng.submit(Request(rid="c", prompt=prompts[2], max_new_tokens=4,
+                           adapter="tA"))
+        eng.run(max_steps=150)  # slot reuse across three tenants
+        _, steady = w.drain()
+    finally:
+        w.deactivate()
+    assert steady == 0, f"{steady} recompile(s) across adapter churn"
+
+
+def test_chaos_mixed_tenant_acceptance_with_eviction(lora_setup):
+    """THE serving-leg acceptance (ISSUE 10): mixed-tenant serving under a
+    binding page pool (eviction + re-prefill) with injected preemption,
+    KV-page corruption, and poisoned logits — every completed request is
+    token-identical to the clean run, per tenant; the doomed request ends
+    typed. No silent drops."""
+    from dtc_tpu.obs import MemorySink
+
+    s = lora_setup
+    prompts = _prompts(6, (6, 8, 5, 7))
+    adapters = ("tA", "tB", None, "tA")
+
+    def build(chaos):
+        eng = _engine(
+            s, slots=2, total_pages=8, max_new_tokens=8,
+            verify_pages_every=1, chaos=chaos or ChaosConfig(),
+        )
+        eng.load_adapter("tA", s["lA"])
+        eng.load_adapter("tB", s["lB"])
+        return eng
+
+    def drive(eng, with_doomed):
+        for i, (p, ad) in enumerate(zip(prompts, adapters)):
+            eng.submit(Request(rid=f"c{i}", prompt=p, max_new_tokens=8,
+                               adapter=ad))
+        if with_doomed:
+            eng.submit(Request(rid="doomed", prompt=[1, 2, 3],
+                               max_new_tokens=8, deadline_s=1e-9))
+        return eng.run(max_steps=600)
+
+    clean = drive(build(None), with_doomed=False)
+    chaos = ChaosConfig(
+        enabled=True, serve_preempt_at_step=4, serve_corrupt_page_at_step=6,
+        serve_poison_logits_at_step=8,
+    )
+    eng = build(chaos)
+    sink = eng.reg.add_sink(MemorySink())
+    faulted = drive(eng, with_doomed=True)
+
+    snap = eng.reg.snapshot()
+    assert snap["chaos_injections"] == 3
+    assert snap["serve_preemptions"] == 1
+    assert snap["serve_corruptions"] == 1
+    assert snap["serve_retries"] >= 1
+    assert sum(r.n_evictions for r in faulted.values()) > 0
+    for i in range(len(prompts)):
+        rid = f"c{i}"
+        assert faulted[rid].state is RequestState.DONE
+        assert faulted[rid].tokens == clean[rid].tokens, rid
+    from dtc_tpu.serve import DeadlineExceededError
+
+    assert faulted["doomed"].state is RequestState.EXPIRED
+    assert isinstance(faulted["doomed"].error, DeadlineExceededError)
+    terminal = [e for e in sink.events if e["etype"] == "serve_request"]
+    assert sorted(e["rid"] for e in terminal) == sorted(faulted)
+    # Pool fully reclaimed; adapter pins all released.
+    assert eng.alloc.free_pages == eng.alloc.total_pages
+    assert eng.adapter_store.refcount("tA") == 0
+    assert eng.adapter_store.refcount("tB") == 0
