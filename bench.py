@@ -174,17 +174,23 @@ def decode_bench(
     prompt_len: int = 32,
     new_tokens: int = 128,
     decode_attention: str = "fused",
+    kv_cache_dtype: str = "auto",
 ) -> dict:
     """KV-cache autoregressive decode throughput on the flagship model —
     the serving surface (the reference trains and plots only; SURVEY §1
     lists no sampling path). Random params: decode cost is shape-, not
     value-, dependent.
 
-    ``decode_attention`` selects the per-layer attention backend
-    (``fused`` = the single-launch Pallas kernel, ``xla`` = the oracle) —
-    the A/B that isolates the kernel's contribution to ms/token. Every
-    row carries the memory-bandwidth roofline for its shape
-    (utils/metrics.decode_roofline_ms at the run's MEAN cache length) and
+    ``decode_attention`` selects the attention backend (``fused_layers``
+    = the layer-fused megakernel, one Pallas launch per TOKEN —
+    ops/decode_fused.py; ``fused`` = the single-launch-per-layer kernel;
+    ``xla`` = the oracle) and ``kv_cache_dtype`` the cache storage
+    (``int8`` = quantized payload + per-head scales) — the A/Bs that
+    isolate launch count and KV bytes from each other. Every row carries
+    the memory-bandwidth roofline for its shape
+    (utils/metrics.decode_roofline_ms at the run's MEAN cache length,
+    DTYPE-CORRECT byte model: the int8 rows are scored against the
+    smaller int8 floor, so their pct_of_roofline is not flattered) and
     ``pct_of_roofline`` = floor/measured, so the serving numbers are
     always read against the same floor PERF.md derives.
 
@@ -208,7 +214,7 @@ def decode_bench(
         **FLAGSHIP_DIMS, n_heads=16,
         max_seq_len=512, dropout=0.0, param_dtype="float32",
         compute_dtype="bfloat16", attention="auto",
-        decode_attention=decode_attention,
+        decode_attention=decode_attention, kv_cache_dtype=kv_cache_dtype,
     )
     model = GPT(model_cfg)
     x = jnp.ones((batch, 1), jnp.int32)
@@ -244,6 +250,7 @@ def decode_bench(
         "prompt_len": prompt_len,
         "new_tokens": new_tokens,
         "decode_attention": decode_attention,
+        "kv_cache_dtype": kv_cache_dtype,
         "wall_s": round(best, 4),
         "prefill_s": round(best_prefill, 4),
         "tokens_per_sec": round(batch * new_tokens / best, 1),
@@ -556,6 +563,8 @@ def serve_bench(
         "queue_wait_p99_s": r4(q("serve_queue_wait_s", 0.99)),
         "platform": jax.devices()[0].platform,
         "serve_model": model_label,
+        "decode_attention": model_cfg.decode_attention,
+        "kv_cache_dtype": model_cfg.kv_cache_dtype,
         "n_tenants": n_tenants,
         "adapter_rank": adapter_rank if n_tenants > 0 else 0,
     }
@@ -601,6 +610,27 @@ def serve_bench_rows(emit, model_cfg=None, *, seed: int = 0, **kw) -> None:
         emit, model_cfg, seed, "serve",
         (("load50", 0.5), ("load90", 0.9), ("sat300", 3.0)), **kw,
     )
+
+
+def serve_int8_row(emit, serve_cfg_kw: dict, *, seed: int = 0) -> None:
+    """The ISSUE 11 serving row: one closed-loop capacity measurement on
+    the layer-fused megakernel + int8 KV cache. A/B against
+    ``serve_cal_closed_loop`` (same arrival shape, fp-cache model) reads
+    the quantized cache's scheduler-level price; the ``*_int8``
+    serve_model label + config fields keep the drift guard comparing
+    like to like."""
+    import dataclasses
+
+    kw = dict(serve_cfg_kw)
+    kw["model_cfg"] = dataclasses.replace(
+        kw.pop("model_cfg", None) or flagship_model_cfg(dropout=0.0),
+        kv_cache_dtype="int8", decode_attention="fused_layers",
+    )
+    kw["model_label"] = kw.get("model_label", "flagship") + "_int8"
+    emit("serve_int8_closed_loop", _safe("serve_int8_closed_loop",
+         lambda: serve_bench(
+             None, seed=seed, queue_depth=kw.get("n_requests", 32),
+             shed_watermark=0.0, **kw)))
 
 
 def serve_lora_rows(
@@ -653,6 +683,14 @@ def decode_drift_guard(extra: dict, repo_dir: str | None = None) -> list[str]:
     under the TPU-tunnel outage — comparing TPU ms/token against them
     would be noise, not drift).
 
+    Same-CONFIG comparisons only (ISSUE 11, the same rule as the PR 6
+    same-platform rule): rows are compared only when their
+    ``decode_attention`` and ``kv_cache_dtype`` labels match — a label
+    whose config changed meaning across rounds (e.g. decode_b8 re-pointed
+    at a different backend) must not be judged against its old self.
+    Rows committed before these fields existed default to the config
+    every pre-ISSUE-11 row actually ran ("fused"/"auto").
+
     Degrades gracefully: a newest file without decode rows (e.g. a round
     whose decode configs all ``_safe``-errored) falls back to older
     files, and when NO committed file carries a decode ms/token the guard
@@ -702,6 +740,15 @@ def decode_drift_guard(extra: dict, repo_dir: str | None = None) -> list[str]:
                     # Committed on different hardware, or measured with a
                     # different serve model (tiny vs flagship rows share
                     # labels): not comparable.
+                    continue
+                # Same-config rule: decode_attention/kv_cache_dtype must
+                # match (pre-ISSUE-11 rows lack the fields and ran the
+                # then-only config — normalize so history stays guarded).
+                cfg_of = lambda r: (  # noqa: E731
+                    r.get("decode_attention", "fused"),
+                    r.get("kv_cache_dtype", "auto"),
+                )
+                if cfg_of(old) != cfg_of(row):
                     continue
                 compared = True
                 new_ms, old_ms = row.get("ms_per_token"), old["ms_per_token"]
@@ -884,6 +931,7 @@ def main(argv: list[str] | None = None) -> None:
     if args.serve_only:
         serve_bench_rows(emit, seed=args.serve_seed, **serve_cfg_kw)
         serve_lora_rows(emit, seed=args.serve_seed, **serve_cfg_kw)
+        serve_int8_row(emit, serve_cfg_kw, seed=args.serve_seed)
         emit("trace_overhead", _safe("trace_overhead", trace_overhead_bench))
         extra = {
             "devices": jax.device_count(),
@@ -980,10 +1028,21 @@ def main(argv: list[str] | None = None) -> None:
     emit("decode_b64", _safe("decode_b64", lambda: decode_bench(batch=64)))
     emit("decode_b8_p256", _safe("decode_b8_p256", lambda: decode_bench(
         prompt_len=256, new_tokens=128)))
+    # ISSUE 11 rows: the layer-fused megakernel (one launch per token —
+    # the launch-count lever) and megakernel+int8 (the KV-bytes lever on
+    # top; pct_of_roofline is computed against the int8 byte model, so
+    # the two levers are separable in the table).
+    emit("decode_b8_fused_layers", _safe("decode_b8_fused_layers",
+         lambda: decode_bench(decode_attention="fused_layers")))
+    emit("decode_b8_int8", _safe("decode_b8_int8", lambda: decode_bench(
+        decode_attention="fused_layers", kv_cache_dtype="int8")))
     # Serving-scheduler rows (ISSUE 6): Poisson arrivals through the
     # continuous-batching engine at calibrated offered loads, including
     # one past saturation — the row that shows shedding holds p99.
     serve_bench_rows(emit, seed=args.serve_seed, **serve_cfg_kw)
+    # int8-KV serving row (ISSUE 11): the closed-loop capacity shape on
+    # the megakernel + int8 cache — see serve_int8_row.
+    serve_int8_row(emit, serve_cfg_kw, seed=args.serve_seed)
     # Multi-tenant LoRA rows (ISSUE 10): N tenants on one resident base;
     # the delta vs the serve_* rows is the per-token multi-tenant price.
     serve_lora_rows(emit, seed=args.serve_seed, **serve_cfg_kw)

@@ -318,16 +318,23 @@ def build_train_artifact(mode: str, *, execute: bool = True) -> Artifact:
         )
 
 
-def build_decode_artifact(*, execute: bool = True) -> Artifact:
+def build_decode_artifact(
+    *, execute: bool = True, decode_attention: str = "fused"
+) -> Artifact:
     """Lower + compile the greedy decode entry point (prefill + token scan
     under one jit — the serving fast path of PR 4) on the default device.
 
     Greedy is the audited flavor: it is the bench's continuity row and its
     HLO must stay free of the sampling machinery. No donation is expected
-    (generate allocates its cache per call)."""
+    (generate allocates its cache per call).
+
+    ``decode_attention="fused_layers"`` audits the ISSUE 11 megakernel
+    flavor as its own entry (``decode_fused_layers``): the layer loop
+    moves from an XLA scan into the Pallas grid, a structurally different
+    program whose drift deserves its own committed baseline."""
     from dtc_tpu.generate import _generate_jit
 
-    model_cfg = audit_model_cfg()
+    model_cfg = audit_model_cfg(decode_attention=decode_attention)
     model = GPT(model_cfg)
     params = jax.jit(
         lambda r, x: model.init({"params": r, "dropout": r}, x, train=False)
@@ -352,7 +359,10 @@ def build_decode_artifact(*, execute: bool = True) -> Artifact:
             lambda _out: _generate_jit(*args, **kwargs),
         )
     return Artifact(
-        name="decode_greedy",
+        name=(
+            "decode_greedy" if decode_attention == "fused"
+            else f"decode_{decode_attention}"
+        ),
         kind="decode",
         parallel=None,
         mesh_shape={},
@@ -372,7 +382,9 @@ def build_decode_artifact(*, execute: bool = True) -> Artifact:
     )
 
 
-def build_serve_artifact(*, execute: bool = True, lora: bool = True) -> Artifact:
+def build_serve_artifact(
+    *, execute: bool = True, lora: bool = True, kv_int8: bool = False
+) -> Artifact:
     """Lower + compile the SERVING decode step — the continuous-batching
     iteration ``dtc_tpu/serve/engine.py`` drives over its fixed slot batch
     (per-slot ``(B,)`` cache frontiers, greedy argmax, finite flag).
@@ -392,6 +404,12 @@ def build_serve_artifact(*, execute: bool = True, lora: bool = True) -> Artifact
     - ``lora=False`` -> ``serve_decode_base``: the adapter-free flavor
       every plain deployment runs — baselined separately so a regression
       in THAT branch cannot hide behind a green lora audit.
+    - ``kv_int8=True`` -> ``serve_decode_int8`` (ISSUE 11): the
+      quantized-cache + layer-fused-megakernel flavor (``kv_cache_dtype:
+      int8`` + ``decode_attention: fused_layers`` with the lora config) —
+      the serving program the int8 bench rows run. Its recompile
+      fingerprint proves admission and tenant churn stay recompile-free
+      when the cache tree grows the int8 payload + scale leaves.
 
     Either way: admission, eviction, and (lora) tenant churn at fixed
     slots must reuse the ONE executable (cold==1, steady==0), or serving
@@ -400,9 +418,13 @@ def build_serve_artifact(*, execute: bool = True, lora: bool = True) -> Artifact
     from dtc_tpu.serve.engine import ServingEngine
     from dtc_tpu.serve.request import Request
 
-    overrides = (
+    overrides: dict[str, Any] = (
         dict(adapter=AdapterConfig(rank=2, alpha=4.0)) if lora else {}
     )
+    if kv_int8:
+        overrides.update(
+            kv_cache_dtype="int8", decode_attention="fused_layers"
+        )
     model_cfg = audit_model_cfg(**overrides)
     model = GPT(model_cfg)
     params = jax.jit(
@@ -465,8 +487,11 @@ def build_serve_artifact(*, execute: bool = True, lora: bool = True) -> Artifact
             return eng.cache
 
         cold, steady = _measure_compiles(call_once, call_again)
+    name = "serve_decode" if lora else "serve_decode_base"
+    if kv_int8:
+        name = "serve_decode_int8"
     return Artifact(
-        name="serve_decode" if lora else "serve_decode_base",
+        name=name,
         kind="serve",
         parallel=None,
         mesh_shape={},
@@ -495,10 +520,20 @@ def build_artifacts(
     arts = [build_train_artifact(m, execute=execute) for m in modes]
     if decode:
         arts.append(build_decode_artifact(execute=execute))
+        # The ISSUE 11 megakernel flavor: layer loop inside the Pallas
+        # grid instead of an XLA scan — its own committed baseline.
+        arts.append(
+            build_decode_artifact(
+                execute=execute, decode_attention="fused_layers"
+            )
+        )
     if serve:
-        # Both serving flavors: the multi-tenant (lora) step AND the
-        # adapter-free step — distinct compiled programs, each with its
-        # own committed baseline.
+        # All serving flavors: the multi-tenant (lora) step, the
+        # adapter-free step, AND the int8+megakernel step — distinct
+        # compiled programs, each with its own committed baseline.
         arts.append(build_serve_artifact(execute=execute, lora=True))
         arts.append(build_serve_artifact(execute=execute, lora=False))
+        arts.append(
+            build_serve_artifact(execute=execute, lora=True, kv_int8=True)
+        )
     return arts

@@ -18,6 +18,12 @@ PyTree = Any
 
 VALID_PARALLEL = ("none", "dp", "tp", "pp", "3d", "fsdp")
 
+#: Bytes per element of every dtype a config knob can name — THE one
+#: table (utils/metrics byte models, serve/paged_cache pool sizing, and
+#: ops/decode_fused's VMEM gate all read it): a future dtype lands here
+#: once or the accounting silently skews in whichever consumer missed it.
+DTYPE_BYTES = {"float32": 4, "bfloat16": 2, "float16": 2, "int8": 1}
+
 #: Dense layers the LoRA injection pass can target (dtc_tpu/adapters/):
 #: the attention projections and the dense-MLP matmuls. The MoE expert
 #: tensors are not injectable (no per-expert adapters yet); with
@@ -128,14 +134,30 @@ class ModelConfig:
     # (bench.py MoE rows measure both; einsum stays default until the
     # on-chip A/B says otherwise, PERF.md).
     moe_dispatch: str = "einsum"
-    # Decode (KV-cache inference) attention backend: "fused" = ONE Pallas
-    # launch per layer per token on the packed (B, S, H·D) cache
-    # (ops/decode_attention.py — the serving fast path; falls back to xla
-    # automatically for multi-token prefill calls and unsupported cache
-    # lengths), "xla" = the einsum/softmax oracle (ops/attention.py
-    # decode_attention) kept as the parity reference — the two are
-    # token-exact on every test in tests/test_generate.py.
+    # Decode (KV-cache inference) attention backend: "fused_layers" = ONE
+    # Pallas launch per TOKEN that scans the layer axis inside the kernel
+    # (ops/decode_fused.py — qkv projection, frontier cache write,
+    # single-query attention, output projection, MLP, residual/LN all per
+    # layer in one resident kernel; falls back per call to the per-layer
+    # path for prefill, MoE models, and unsupported shapes), "fused" =
+    # ONE Pallas launch per layer per token on the packed (B, S, H·D)
+    # cache (ops/decode_attention.py; falls back to xla automatically for
+    # multi-token prefill calls and unsupported cache lengths), "xla" =
+    # the einsum/softmax oracle (ops/attention.py decode_attention) kept
+    # as the parity reference — all three are token-exact on every test
+    # in tests/test_generate.py + tests/test_decode_fused.py.
     decode_attention: str = "fused"
+    # KV-cache storage dtype: "auto" (= compute_dtype, the legacy
+    # behavior), "float32"/"bfloat16" explicit overrides (aliases
+    # "fp32"/"bf16" accepted), or "int8" — symmetric per-(position, head)
+    # scale quantization on cache write (ops/decode_attention.quantize_kv
+    # — the reference arithmetic the kernels replicate in-register),
+    # dequantized in-register inside the decode kernels. int8 halves the
+    # decode roofline's KV bytes vs bf16 (utils/metrics.decode_step_bytes)
+    # and doubles paged-cache capacity per HBM byte
+    # (ServeConfig.pool_hbm_bytes); greedy parity vs fp32 is measured in
+    # tests/test_decode_fused.py and PERF.md round 10.
+    kv_cache_dtype: str = "auto"
     # Dev knob: emit checkify.check guards for traced invariants that
     # cannot raise at trace time (currently the decode-cache write
     # frontier, whose dynamic_update_slice would otherwise CLAMP on
@@ -171,10 +193,24 @@ class ModelConfig:
                 f"unknown moe_dispatch {self.moe_dispatch!r}; "
                 "expected 'einsum' or 'sort'"
             )
-        if self.decode_attention not in ("fused", "xla"):
+        if self.decode_attention not in ("fused_layers", "fused", "xla"):
             raise ValueError(
                 f"unknown decode_attention {self.decode_attention!r}; "
-                "expected 'fused' or 'xla'"
+                "expected 'fused_layers', 'fused' or 'xla'"
+            )
+        # Normalize the kv-cache dtype aliases BEFORE validating, so YAML
+        # configs may say fp32/bf16 (the knob-doc spelling) while every
+        # consumer reads one canonical token.
+        aliases = {"fp32": "float32", "bf16": "bfloat16"}
+        if self.kv_cache_dtype in aliases:
+            object.__setattr__(
+                self, "kv_cache_dtype", aliases[self.kv_cache_dtype]
+            )
+        if self.kv_cache_dtype not in ("auto", "float32", "bfloat16", "int8"):
+            raise ValueError(
+                f"unknown kv_cache_dtype {self.kv_cache_dtype!r}; expected "
+                "'auto' (= compute_dtype), 'fp32'/'float32', "
+                "'bf16'/'bfloat16' or 'int8'"
             )
         # Cross-field: with MoE, the dense fc1/fc2 layers don't exist, so
         # an adapter targeting only them would create ZERO injection
@@ -218,6 +254,20 @@ class ModelConfig:
     @property
     def head_dim(self) -> int:
         return self.d_model // self.n_heads
+
+    @property
+    def kv_store_dtype(self) -> str:
+        """``kv_cache_dtype`` resolved: "auto" means the compute dtype
+        (the legacy cache layout — existing programs are byte-identical)."""
+        if self.kv_cache_dtype == "auto":
+            return self.compute_dtype
+        return self.kv_cache_dtype
+
+    @property
+    def kv_quantized(self) -> bool:
+        """True when the KV cache stores int8 + per-(position, head)
+        scales instead of a float payload."""
+        return self.kv_store_dtype == "int8"
 
     @property
     def remat_mode(self) -> str:
@@ -526,6 +576,14 @@ class ServeConfig:
     # the pool never binds; set it lower to model a cache smaller than the
     # worst case and exercise eviction-and-re-prefill).
     total_pages: int = 0
+    # Alternative pool sizing as an HBM BYTE budget for KV payload: the
+    # engine derives total_pages = pool_hbm_bytes // (page_size ×
+    # per-token KV bytes at the model's kv_cache_dtype — see
+    # serve.paged_cache.kv_token_bytes). The SAME byte budget holds 2×
+    # the pages under int8 vs bf16 (4× vs fp32): quantization buys
+    # resident tenants/prefixes, not just bandwidth. Mutually exclusive
+    # with total_pages; 0 = off.
+    pool_hbm_bytes: int = 0
     # Admission control: submit() beyond this depth raises a typed
     # QueueFullError (backpressure — never a silent drop).
     queue_depth: int = 64
@@ -589,6 +647,13 @@ class ServeConfig:
             raise ValueError("page_size must be >= 1")
         if self.total_pages < 0:
             raise ValueError("total_pages must be >= 0 (0 = auto)")
+        if self.pool_hbm_bytes < 0:
+            raise ValueError("pool_hbm_bytes must be >= 0 (0 = off)")
+        if self.pool_hbm_bytes > 0 and self.total_pages > 0:
+            raise ValueError(
+                "total_pages and pool_hbm_bytes are mutually exclusive pool "
+                "sizings — set one (pages) or the other (bytes), not both"
+            )
         if self.queue_depth < 1:
             raise ValueError("queue_depth must be >= 1")
         if self.max_new_tokens < 1:

@@ -66,7 +66,20 @@ def decode_step(model, params: PyTree, cache: PyTree, tok: jax.Array,
     (``cfg.adapter.rank > 0``): one shared adapter as-initialized
     (per-site ``(L, in, r)`` factors), or the serving engine's per-slot
     gathered stack (``(L, B, in, r)`` — each batch row decodes under its
-    own tenant's adapter). Required iff the model has adapters."""
+    own tenant's adapter). Required iff the model has adapters.
+
+    With ``cfg.decode_attention == "fused_layers"`` the single-token call
+    routes through the layer-fused megakernel
+    (:func:`dtc_tpu.ops.decode_fused.fused_decode_step` — ONE Pallas
+    launch scans every layer; O(1) launches per token instead of
+    O(layers)·O(ops)); prefill and unsupported shapes fall back to the
+    per-layer model apply below. Because BOTH drivers route here, the
+    megakernel serves generate's scalar frontier and the engine's (B,)
+    slot frontiers from the same code path."""
+    from dtc_tpu.ops import decode_fused
+
+    if decode_fused.use_fused_layers(model.cfg, tok.shape[1]):
+        return decode_fused.fused_decode_step(model, params, cache, tok, lora)
     variables = {"params": params, "cache": cache}
     if lora is not None:
         variables["lora"] = lora

@@ -113,67 +113,100 @@ class CausalSelfAttention(nn.Module):
                 )
             idx = decode_index
             hd = cfg.n_heads * cfg.head_dim
+            quant = cfg.kv_quantized
+            kv_dt = jnp.int8 if quant else _dtype(cfg.kv_store_dtype)
             ck = self.variable(
-                "cache", "k", jnp.zeros, (b, cfg.max_seq_len, hd), cdtype,
+                "cache", "k", jnp.zeros, (b, cfg.max_seq_len, hd), kv_dt,
             )
             cv = self.variable(
-                "cache", "v", jnp.zeros, (b, cfg.max_seq_len, hd), cdtype,
+                "cache", "v", jnp.zeros, (b, cfg.max_seq_len, hd), kv_dt,
             )
+            if quant:
+                # Per-(position, head) fp32 scales next to the int8
+                # payload (ops/decode_attention.quantize_kv) — ~1/(2·D)
+                # of the bf16 payload's bytes, accounted as metadata
+                # overhead (utils/metrics.decode_step_bytes counts it in
+                # the roofline; the paged-pool budget does not).
+                cks = self.variable(
+                    "cache", "k_scale", jnp.zeros,
+                    (b, cfg.max_seq_len, cfg.n_heads), jnp.float32,
+                )
+                cvs = self.variable(
+                    "cache", "v_scale", jnp.zeros,
+                    (b, cfg.max_seq_len, cfg.n_heads), jnp.float32,
+                )
+
             # Logical constraints shard the cache over heads under a TP
             # mesh (the packed lane axis IS the head axis × head_dim, so
-            # sharding it over "model" is head sharding; seq stays
-            # unsharded and the dynamic update partitions trivially);
-            # decode then runs head-parallel up to out_proj's all-reduce,
-            # same as training.
-            if idx.ndim == 1:
-                # Per-slot frontiers (the serving runtime's continuous
-                # batching: the cache index is (B,), one write position
-                # per slot). The batched dynamic_update_slice lowers to a
-                # scatter — each row writes at its own frontier.
-                write = jax.vmap(
-                    lambda c, u, i: jax.lax.dynamic_update_slice(c, u, (i, 0))
+            # sharding it over "model" is head sharding — and the scale
+            # cache's last axis IS the head axis; seq stays unsharded and
+            # the dynamic update partitions trivially); decode then runs
+            # head-parallel up to out_proj's all-reduce, same as training.
+            def cache_write(var, update):
+                if idx.ndim == 1:
+                    # Per-slot frontiers (the serving runtime's continuous
+                    # batching: the cache index is (B,), one write
+                    # position per slot). The batched dynamic_update_slice
+                    # lowers to a scatter — each row writes at its own
+                    # frontier.
+                    new = jax.vmap(
+                        lambda c, u, i: jax.lax.dynamic_update_slice(
+                            c, u, (i, 0)
+                        )
+                    )(var.value, update, idx)
+                else:
+                    new = jax.lax.dynamic_update_slice(
+                        var.value, update, (0, idx, 0)
+                    )
+                var.value = nn.with_logical_constraint(
+                    new, ("batch", "seq", "heads")
                 )
-                ck.value = nn.with_logical_constraint(
-                    write(ck.value, k.reshape(b, t, hd), idx),
-                    ("batch", "seq", "heads"),
-                )
-                cv.value = nn.with_logical_constraint(
-                    write(cv.value, v.reshape(b, t, hd), idx),
-                    ("batch", "seq", "heads"),
-                )
+
+            if quant:
+                kq, ksc = fused.quantize_kv(k.reshape(b, t, hd), cfg.n_heads)
+                vq, vsc = fused.quantize_kv(v.reshape(b, t, hd), cfg.n_heads)
+                cache_write(ck, kq)
+                cache_write(cv, vq)
+                cache_write(cks, ksc)
+                cache_write(cvs, vsc)
             else:
-                ck.value = nn.with_logical_constraint(
-                    jax.lax.dynamic_update_slice(
-                        ck.value, k.reshape(b, t, hd), (0, idx, 0)
-                    ),
-                    ("batch", "seq", "heads"),
-                )
-                cv.value = nn.with_logical_constraint(
-                    jax.lax.dynamic_update_slice(
-                        cv.value, v.reshape(b, t, hd), (0, idx, 0)
-                    ),
-                    ("batch", "seq", "heads"),
-                )
+                cache_write(ck, k.reshape(b, t, hd).astype(kv_dt))
+                cache_write(cv, v.reshape(b, t, hd).astype(kv_dt))
             if (
-                cfg.decode_attention == "fused"
+                cfg.decode_attention in ("fused", "fused_layers")
                 and t == 1
                 and fused.supports(cfg.max_seq_len)
             ):
                 # The serving fast path: one Pallas launch reads the whole
-                # packed cache, masked to the frontier. Multi-token calls
-                # (prefill — once per sequence) and unsupported cache
-                # lengths take the XLA oracle below.
+                # packed cache, masked to the frontier (int8 caches ride
+                # their scales in; dequant is in-register). Multi-token
+                # calls (prefill — once per sequence) and unsupported
+                # cache lengths take the XLA oracle below. fused_layers
+                # reaching HERE means a call the megakernel declined
+                # (prefill, or an unsupported shape) — the per-layer
+                # kernel is its fallback before the oracle.
                 with jax.named_scope("attn_kernel"):
                     out = fused.fused_decode_attention(
                         q.reshape(b, 1, hd), ck.value, cv.value, idx,
                         h=cfg.n_heads, d=cfg.head_dim,
+                        k_scale=cks.value if quant else None,
+                        v_scale=cvs.value if quant else None,
                     ).reshape(b, 1, cfg.n_heads, cfg.head_dim)
             else:
                 with jax.named_scope("attn_kernel"):
+                    if quant:
+                        k_full = fused.dequantize_kv(
+                            ck.value, cks.value, cfg.n_heads, cdtype
+                        )
+                        v_full = fused.dequantize_kv(
+                            cv.value, cvs.value, cfg.n_heads, cdtype
+                        )
+                    else:
+                        k_full, v_full = ck.value, cv.value
                     out = decode_attention(
                         q,
-                        ck.value.reshape(b, cfg.max_seq_len, cfg.n_heads, cfg.head_dim),
-                        cv.value.reshape(b, cfg.max_seq_len, cfg.n_heads, cfg.head_dim),
+                        k_full.reshape(b, cfg.max_seq_len, cfg.n_heads, cfg.head_dim),
+                        v_full.reshape(b, cfg.max_seq_len, cfg.n_heads, cfg.head_dim),
                         idx,
                     )
         else:

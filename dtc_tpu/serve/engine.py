@@ -75,7 +75,7 @@ from dtc_tpu.resilience.chaos import ChaosInjector
 from dtc_tpu.resilience.events import RecoveryBus
 from dtc_tpu.resilience.retry import retry_call
 from dtc_tpu.resilience.watchdog import StepWatchdog
-from dtc_tpu.serve.paged_cache import PageAllocator, pages_for
+from dtc_tpu.serve.paged_cache import PageAllocator, kv_token_bytes, pages_for
 from dtc_tpu.serve.request import (
     TERMINAL_STATES,
     DeadlineExceededError,
@@ -206,9 +206,22 @@ class ServingEngine:
             cfg.chaos.enabled and cfg.chaos.serve_corrupt_page_at_step > 0
         )
 
-        pool = cfg.total_pages or cfg.slots * pages_for(
-            self.mcfg.max_seq_len, cfg.page_size
-        )
+        if cfg.pool_hbm_bytes > 0:
+            # Byte-budget sizing: the pool is however many pages of KV
+            # payload fit the budget at the model's kv_cache_dtype —
+            # int8 holds 2× the pages of bf16 (4× of fp32) in the same
+            # bytes, i.e. quantization buys resident tenants/prefixes,
+            # not just bandwidth (see paged_cache.kv_token_bytes for the
+            # scale-sidecar honesty note).
+            pool = max(
+                1,
+                cfg.pool_hbm_bytes
+                // (cfg.page_size * kv_token_bytes(self.mcfg)),
+            )
+        else:
+            pool = cfg.total_pages or cfg.slots * pages_for(
+                self.mcfg.max_seq_len, cfg.page_size
+            )
         self.alloc = PageAllocator(pool, cfg.page_size)
 
         # Multi-tenant adapters (dtc_tpu/adapters/): with an adapter-
@@ -244,6 +257,44 @@ class ServingEngine:
         self._fps_memo: Any = None  # checksum table for the CURRENT cache
 
         self._build_fns()
+        self._settle_cache_sharding()
+
+    def _settle_cache_sharding(self) -> None:
+        """Kill the PR 9 gotcha at construction: an engine fed
+        GSPMD-sharded base params (a trainer-produced base) used to pay
+        one EXTRA ``insert_fn`` compile on the first decode — the step's
+        output cache settles its GSPMD-normalized sharding only then, so
+        an insert compiled against the construction-time (uncommitted)
+        cache stopped matching and silently recompiled inside the first
+        compile-sensitive window (the two-admission warmup in
+        adapter_smoke worked around it).
+
+        Fix: when (and only when) the params carry NamedShardings, run
+        ONE throwaway decode step here and adopt its output cache — the
+        step's cold compile moves to construction (it was inevitable)
+        and every later ``insert_fn``/``step_fn`` call sees the settled
+        layout. Unsharded params (every CPU test, the audit's lowered
+        entries) skip this entirely: no extra compile, baselines
+        unchanged. The warm step writes garbage k/v at position 0 of
+        every slot and advances the per-slot index once — both idle-slot
+        states the scheduler already treats as meaningless (admission
+        surgery overwrites the full row and pins the frontier)."""
+        sharded = any(
+            isinstance(getattr(leaf, "sharding", None), jax.sharding.NamedSharding)
+            for leaf in jax.tree.leaves(self.params)
+        )
+        if not sharded:
+            return
+        toks = jnp.zeros((self.cfg.slots,), jnp.int32)
+        if self.lora_on:
+            warmed, _, _ = self._step_fn(
+                self.params, self.lora_stack,
+                jnp.asarray(self.slot_adapter), self.cache, toks,
+            )
+        else:
+            warmed, _, _ = self._step_fn(self.params, self.cache, toks)
+        self.cache = warmed
+        self._fps_memo = None
 
     # ------------------------------------------------------------------
     # jitted device functions (each compiles ONCE; every per-request

@@ -36,6 +36,28 @@ def pages_for(n_tokens: int, page_size: int) -> int:
     return math.ceil(n_tokens / page_size) if n_tokens > 0 else 0
 
 
+def kv_token_bytes(model_cfg) -> int:
+    """KV payload bytes ONE resident token occupies across all layers —
+    the dtype-aware unit the byte-budget pool sizing
+    (``ServeConfig.pool_hbm_bytes``) divides by: K + V, packed ``H·D``
+    wide, per layer, at ``kv_cache_dtype``. int8 is exactly half bf16
+    and a quarter fp32, which is the "quantization doubles page
+    capacity" arithmetic the acceptance test pins.
+
+    Honesty note: the int8 scale sidecars (fp32 per position per head,
+    ``1/(2·D)`` of the bf16 payload — ~3% at head_dim 32) are metadata
+    OUTSIDE this unit, exactly as vLLM-style allocators account block
+    storage but not block tables. The decode roofline
+    (utils/metrics.decode_step_bytes) counts them, because there they
+    are real bandwidth."""
+    from dtc_tpu.config.schema import DTYPE_BYTES
+
+    hd = model_cfg.n_heads * model_cfg.head_dim
+    return 2 * model_cfg.n_layers * hd * DTYPE_BYTES.get(
+        model_cfg.kv_store_dtype, 4
+    )
+
+
 class PageAllocator:
     """Bookkeeping for one page pool: per-owner page counts, free count,
     and LRU-stamped prefix-store pins. Pure host-side accounting — device

@@ -122,7 +122,9 @@ def moe_step_flops_useful(cfg: ModelConfig, batch: int, seq_len: int) -> float:
 
 
 def _dtype_bytes(dtype: str) -> int:
-    return {"float32": 4, "bfloat16": 2, "float16": 2}.get(dtype, 4)
+    from dtc_tpu.config.schema import DTYPE_BYTES
+
+    return DTYPE_BYTES.get(dtype, 4)
 
 
 #: Sustained HBM bandwidth per v5e chip (GB/s) — the denominator of the
@@ -170,11 +172,16 @@ def decode_step_bytes(
       (``cache_len`` columns) — the bandwidth-OPTIMAL traffic a
       single-query step needs, which keeps this a true floor. Neither
       current path achieves it: the XLA oracle and the single-tile fused
-      kernel read the full ``max_seq_len`` buffer, and the blocked
+      kernels read the full ``max_seq_len`` buffer, and the blocked
       kernel's beyond-frontier skip predicates the compute only (the
       pipeline still copies every block in), so measured pct-of-roofline
-      carries that slack on top of launch overhead.
-    - ``kv_write``: the new token's k/v appended per layer.
+      carries that slack on top of launch overhead. The element size
+      follows ``cfg.kv_cache_dtype``: int8 moves the 1-byte payload PLUS
+      the per-(position, head) fp32 scales (counted honestly — they are
+      real HBM traffic, ~1/(2·D) of the bf16 payload), so int8 cuts this
+      term ~2× vs bf16 and ~4× vs fp32, not exactly.
+    - ``kv_write``: the new token's k/v appended per layer (same
+      dtype-and-scales accounting as ``kv_read``).
     - ``activations``: residual stream + qkv/attn-out + the d_ff-wide MLP
       intermediate crossing HBM once each per layer, plus the final
       logits row — an estimate (XLA fuses some of these into neighbors),
@@ -193,8 +200,14 @@ def decode_step_bytes(
     n = param_count(cfg)
     n_matmul = n - cfg.padded_vocab_size * d - cfg.max_seq_len * d
     weights = float(n_matmul) * pbytes
-    kv_read = 2.0 * cfg.n_layers * cache_len * hd * cbytes * batch
-    kv_write = 2.0 * cfg.n_layers * hd * cbytes * batch
+    # Per cache position per layer: both payloads in the store dtype,
+    # plus — int8 only — the two fp32 per-head scale vectors
+    # (ops/decode_attention.quantize_kv).
+    kv_pos = 2.0 * hd * _dtype_bytes(cfg.kv_store_dtype)
+    if cfg.kv_quantized:
+        kv_pos += 2.0 * cfg.n_heads * 4.0
+    kv_read = cfg.n_layers * cache_len * kv_pos * batch
+    kv_write = cfg.n_layers * kv_pos * batch
     # Per layer: residual in/out (2d), two LN reads (2d, fp32 but count
     # cbytes — fused), qkv out (3d), attention out + proj out (2d), MLP
     # intermediate write+read (2·d_ff), MLP out (d) ≈ 10·d + 2·d_ff per

@@ -115,30 +115,28 @@ def main() -> int:
     ))
     eng.load_adapter("t1", adapters["t1"])
     eng.load_adapter("t2", adapters["t2"])
-    # Warm the compiled surfaces — prefill, cache insert, AND the batched
-    # decode step (max_new_tokens > 1, or the request completes at
-    # prefill and decode first compiles inside the measured window) —
-    # then assert the mixed-tenant batch runs recompile-free (the
-    # serve_decode audit invariant, live). TWO sequential warm
-    # admissions: the trainer-produced base params carry GSPMD
-    # shardings, so the first decode's output cache settles the insert
-    # signature once — the second admission compiles against the settled
-    # layout (a one-time cost any sharded-params deployment pays; the
-    # invariant under test is zero recompiles at steady state).
-    eng.submit(Request(rid="warm", prompt=[1, 2, 3], max_new_tokens=3,
-                       adapter="t1"))
-    eng.run(max_steps=16)
-    eng.submit(Request(rid="warm2", prompt=[4, 5], max_new_tokens=3,
-                       adapter="t2"))
-    eng.run(max_steps=16)
+    # NO warmup admissions (ISSUE 11 satellite — the PR 9 two-admission
+    # workaround is dead): the engine auto-warms at CONSTRUCTION when
+    # the base params are GSPMD-sharded (trainer-produced), settling the
+    # cache sharding before any insert compiles. The watcher therefore
+    # measures the honest lifecycle: window 1 (the first mixed-tenant
+    # batch) pays each compiled surface's ONE cold compile; window 2 (an
+    # identical second batch — same prompt buckets, same tenants) must
+    # be recompile-free. Without the construction settle, window 2's
+    # admissions would recompile insert_fn against the post-decode
+    # settled cache layout and fail the steady==0 assert below.
+    tenants = ("t1", "t2", None)
     w = CompileWatcher().activate()
     try:
         w.drain()
-        eng.submit(Request(rid="r0", prompt=prompts[0], max_new_tokens=6,
-                           adapter="t1"))
-        eng.submit(Request(rid="r1", prompt=prompts[1], max_new_tokens=6,
-                           adapter="t2"))
-        eng.submit(Request(rid="r2", prompt=prompts[2], max_new_tokens=6))
+        for i in range(3):
+            eng.submit(Request(rid=f"r{i}", prompt=prompts[i],
+                               max_new_tokens=6, adapter=tenants[i]))
+        res = eng.run(max_steps=200)
+        _, cold = w.drain()
+        for i in range(3):
+            eng.submit(Request(rid=f"s{i}", prompt=prompts[i],
+                               max_new_tokens=6, adapter=tenants[i]))
         res = eng.run(max_steps=200)
         _, steady = w.drain()
     finally:
@@ -146,18 +144,23 @@ def main() -> int:
 
     ok = True
     for i in range(3):
-        r = res[f"r{i}"]
-        match = r.state is RequestState.DONE and r.tokens == refs[i]
-        ok &= match
-        print(f"[adapter-smoke] r{i} (adapter={r.adapter}): {r.state.value} "
-              f"tokens={r.tokens} {'OK' if match else f'MISMATCH (want {refs[i]})'}")
+        for batch_rid in (f"r{i}", f"s{i}"):
+            r = res[batch_rid]
+            match = r.state is RequestState.DONE and r.tokens == refs[i]
+            ok &= match
+            print(f"[adapter-smoke] {batch_rid} (adapter={r.adapter}): "
+                  f"{r.state.value} tokens={r.tokens} "
+                  f"{'OK' if match else f'MISMATCH (want {refs[i]})'}")
+    print(f"[adapter-smoke] cold compiles (batch 1): {cold}")
     if not diverged:
         print("[adapter-smoke] FAIL: the two finetunes produced identical "
               "adapters — training never moved the lora subtree")
         ok = False
     if steady != 0:
         print(f"[adapter-smoke] FAIL: {steady} steady-state recompile(s) "
-              "across mixed-tenant admissions")
+              "across mixed-tenant admissions (batch 2 after an identical "
+              "batch 1 — the construction-time cache-sharding settle is "
+              "broken if this fires)")
         ok = False
     snap = eng.reg.snapshot()
     print(f"[adapter-smoke] adapter_loads={snap.get('adapter_loads')} "

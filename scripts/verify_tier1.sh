@@ -12,7 +12,8 @@
 # (no tests execute).
 timeout -k 10 120 env JAX_PLATFORMS=cpu python -m pytest --collect-only -q -p no:cacheprovider \
   tests/test_moe.py tests/test_collectives_hlo.py \
-  tests/test_generate.py tests/test_metrics.py tests/test_analysis.py \
+  tests/test_generate.py tests/test_decode_fused.py tests/test_metrics.py \
+  tests/test_analysis.py \
   tests/test_serve.py tests/test_trace.py tests/test_devprof.py \
   tests/test_adapters.py > /dev/null || {
     echo "tier-1 pre-gate: MoE/HLO/decode/analysis/serve/trace/devprof/adapters test collection failed" >&2; exit 1; }
@@ -30,7 +31,11 @@ timeout -k 10 120 env JAX_PLATFORMS=cpu python -m pytest --collect-only -q -p no
 # INTENDED graph change: re-bless with
 #   python scripts/audit_graph.py --modes dp,tp,fsdp,ep --decode --serve --write-baseline
 # and commit the baseline diff.
-timeout -k 10 480 env JAX_PLATFORMS=cpu python scripts/audit_graph.py \
+# (ISSUE 11 grew the entry set to 9: --decode now also audits the
+# layer-fused megakernel flavor `decode_fused_layers`, and --serve the
+# int8-cache `serve_decode_int8` flavor — timeout raised 480 -> 660 for
+# the two extra lower+compile+execute passes on this 1-core host.)
+timeout -k 10 660 env JAX_PLATFORMS=cpu python scripts/audit_graph.py \
   --modes dp,tp,fsdp,ep --decode --serve --check-baselines || {
     echo "tier-1 pre-gate: graph audit failed (see findings above)" >&2; exit 1; }
 # Pre-gate 3 (ISSUE 6): fast scheduler smoke — four requests (two sharing
@@ -56,8 +61,11 @@ timeout -k 10 300 env JAX_PLATFORMS=cpu python scripts/trace_smoke.py || {
 # with every dot-class op attributed, and the merged host+device
 # Perfetto export must hold both timelines on aligned wall clocks.
 # Skips (exit 0) with a warning in environments whose profiler emits no
-# op events at all. ~1-2 min.
-timeout -k 10 300 env JAX_PLATFORMS=cpu python scripts/devprof_smoke.py || {
+# op events at all. ~1-2 min. ISSUE 11 adds the decode launch-count
+# cross-check (per-layer vs fused_layers: while-census hard assert +
+# scan/data_movement share A/B) — timeout raised 300 -> 480 for the two
+# extra decode compiles.
+timeout -k 10 480 env JAX_PLATFORMS=cpu python scripts/devprof_smoke.py || {
     echo "tier-1 pre-gate: devprof smoke failed" >&2; exit 1; }
 # Pre-gate 6 (ISSUE 10): adapter-loop smoke — two LoRA adapters finetuned
 # 3 steps each through the real trainer (adapter-only TrainState, shared

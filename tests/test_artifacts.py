@@ -157,3 +157,42 @@ def test_decode_drift_guard_degrades_gracefully(tmp_path, capsys):
     # Within the 20% band: clean.
     extra = {"decode_b8": {"ms_per_token": 5.5}}
     assert decode_drift_guard(extra, d) == []
+
+
+def test_decode_drift_guard_same_config_only(tmp_path):
+    """ISSUE 11 satellite: rows compare only when their
+    decode_attention/kv_cache_dtype labels match — a label re-pointed at
+    a different backend/cache dtype must not be judged against its old
+    self. Rows committed before the fields existed normalize to the
+    config they actually ran ("fused"/"auto")."""
+    from bench import decode_drift_guard
+
+    d = str(tmp_path)
+    _bench_file(
+        os.path.join(d, "BENCH_r01.json"),
+        {
+            "decode_b8": {"ms_per_token": 5.0},  # pre-ISSUE-11: no fields
+            "decode_b8_int8": {
+                "ms_per_token": 4.0, "decode_attention": "fused_layers",
+                "kv_cache_dtype": "int8",
+            },
+        },
+    )
+    # Same label, DIFFERENT config: not comparable — no flag despite 3x.
+    extra = {"decode_b8": {
+        "ms_per_token": 15.0, "decode_attention": "fused_layers",
+        "kv_cache_dtype": "auto",
+    }}
+    assert decode_drift_guard(extra, d) == []
+    # Same label, matching config (normalized old row): flags as before.
+    extra = {"decode_b8": {
+        "ms_per_token": 15.0, "decode_attention": "fused",
+        "kv_cache_dtype": "auto",
+    }}
+    assert len(decode_drift_guard(extra, d)) == 1
+    # int8 row vs its committed int8 self: matching explicit fields.
+    extra = {"decode_b8_int8": {
+        "ms_per_token": 9.0, "decode_attention": "fused_layers",
+        "kv_cache_dtype": "int8",
+    }}
+    assert len(decode_drift_guard(extra, d)) == 1

@@ -1,0 +1,436 @@
+"""Layer-fused decode megakernel + int8 KV cache (ISSUE 11).
+
+Two invariants pin the whole PR:
+
+1. The ``fused_layers`` megakernel (ops/decode_fused.py — one Pallas
+   launch scans every layer) is TOKEN-EXACT against the ``xla`` einsum
+   oracle on every decode path: greedy, sampled, the serving engine's
+   vector (B,) frontier, and per-row stacked-LoRA factors — fp32 and
+   int8 caches alike.
+2. int8 KV quantization (ops/decode_attention.quantize_kv, per-(position,
+   head) scales) round-trips within its pinned error bound, its greedy
+   divergence from fp32 is measured and documented, its roofline bytes
+   are hand-checked, and the byte-budget page pool doubles its capacity.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dtc_tpu.config.schema import AdapterConfig, ModelConfig, ServeConfig
+from dtc_tpu.generate import decode_step, generate, init_cache
+from dtc_tpu.models.gpt import GPT
+from dtc_tpu.ops import decode_fused
+from dtc_tpu.ops.decode_attention import dequantize_kv, quantize_kv
+
+
+@pytest.fixture
+def params(tiny_model_cfg):
+    model = GPT(tiny_model_cfg)
+    x = jnp.ones((2, 4), jnp.int32)
+    return model.init({"params": jax.random.PRNGKey(7)}, x, train=False)[
+        "params"
+    ]
+
+
+def _variant(cfg, backend, kv="auto", **over):
+    return GPT(dataclasses.replace(
+        cfg, decode_attention=backend, kv_cache_dtype=kv, **over
+    ))
+
+
+@pytest.mark.parametrize("kv", ["auto", "int8"])
+def test_fused_layers_greedy_token_exact(tiny_model_cfg, params, kv):
+    prompt = jax.random.randint(
+        jax.random.PRNGKey(0), (2, 5), 0, tiny_model_cfg.vocab_size, jnp.int32
+    )
+    got = generate(_variant(tiny_model_cfg, "fused_layers", kv), params, prompt, 12)
+    ref = generate(_variant(tiny_model_cfg, "xla", kv), params, prompt, 12)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+
+
+@pytest.mark.parametrize("kv", ["auto", "int8"])
+def test_fused_layers_sampled_token_exact(tiny_model_cfg, params, kv):
+    prompt = jax.random.randint(
+        jax.random.PRNGKey(1), (2, 5), 0, tiny_model_cfg.vocab_size, jnp.int32
+    )
+    kw = dict(temperature=0.8, top_k=20, top_p=0.95)
+    got = generate(
+        _variant(tiny_model_cfg, "fused_layers", kv), params, prompt, 10,
+        jax.random.PRNGKey(3), **kw,
+    )
+    ref = generate(
+        _variant(tiny_model_cfg, "xla", kv), params, prompt, 10,
+        jax.random.PRNGKey(3), **kw,
+    )
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+
+
+@pytest.mark.parametrize("kv", ["auto", "int8"])
+def test_fused_layers_serving_vector_index(tiny_model_cfg, params, kv):
+    """Per-slot (B,) frontiers at DIFFERENT positions: the megakernel's
+    per_row flavor must match the oracle row-for-row."""
+    cfg = tiny_model_cfg
+    prompts = [
+        jax.random.randint(jax.random.PRNGKey(4), (5,), 0, cfg.vocab_size, jnp.int32),
+        jax.random.randint(jax.random.PRNGKey(5), (3,), 0, cfg.vocab_size, jnp.int32),
+    ]
+    outs = {}
+    for backend in ("fused_layers", "xla"):
+        model = _variant(cfg, backend, kv)
+        # Prefill each row on its own batch-1 cache (scalar index —
+        # prefill always takes the per-layer path), then stack into a
+        # 2-slot cache with a (B,) frontier vector — rows mid-decode at
+        # different positions, the engine's steady state.
+        rows, first = [], []
+        for p in prompts:
+            cache = init_cache(model, 1)
+            cache, logits = decode_step(model, params, cache, p[None])
+            rows.append(cache)
+            first.append(int(jnp.argmax(logits[0, -1])))
+        merged = jax.tree.map(
+            lambda *ls: (
+                jnp.stack([jnp.asarray(x, jnp.int32).reshape(()) for x in ls])
+                if ls[0].ndim == 0
+                else jnp.concatenate(ls, axis=ls[0].ndim - 3)
+            ),
+            *rows,
+        )
+        toks = jnp.asarray(first, jnp.int32)[:, None]
+        got = [np.asarray(toks[:, 0])]
+        cache = merged
+        for _ in range(6):
+            cache, logits = decode_step(model, params, cache, toks)
+            toks = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)[:, None]
+            got.append(np.asarray(toks[:, 0]))
+        outs[backend] = np.stack(got, axis=1)
+    np.testing.assert_array_equal(outs["fused_layers"], outs["xla"])
+
+
+@pytest.mark.parametrize("kv", ["auto", "int8"])
+def test_fused_layers_stacked_lora_token_exact(tiny_model_cfg, kv):
+    """Per-row gathered factors (L, B, in, r) — row 0 under a real
+    adapter, row 1 under the all-zero base — must match the oracle's
+    batched-LoRA path row-for-row."""
+    from dtc_tpu.adapters import init_lora
+
+    cfg = dataclasses.replace(
+        tiny_model_cfg, adapter=AdapterConfig(rank=2, alpha=4.0)
+    )
+    model_ref = _variant(cfg, "xla", kv)
+    params = model_ref.init(
+        {"params": jax.random.PRNGKey(7)}, jnp.ones((2, 4), jnp.int32),
+        train=False,
+    )["params"]
+    shared = jax.tree.map(lambda a: a + 0.07, init_lora(model_ref, seed=1))
+    # Gathered per-row stack: row 0 = the adapter, row 1 = zeros (base).
+    perrow = jax.tree.map(
+        lambda a: jnp.stack([a, jnp.zeros_like(a)], axis=1), shared
+    )
+    prompt = jax.random.randint(
+        jax.random.PRNGKey(6), (2, 4), 0, cfg.vocab_size, jnp.int32
+    )
+    outs = {}
+    for backend in ("fused_layers", "xla"):
+        model = _variant(cfg, backend, kv)
+        cache = dict(init_cache(model, 2))
+        cache["index"] = jnp.zeros((2,), jnp.int32)  # vector frontier
+        # feed the prompt token by token (t==1 keeps the megakernel
+        # engaged; prefill would fall back by design)
+        got = []
+        for i in range(prompt.shape[1]):
+            cache, logits = decode_step(model, params, cache, prompt[:, i:i + 1], perrow)
+        toks = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)[:, None]
+        for _ in range(6):
+            got.append(np.asarray(toks[:, 0]))
+            cache, logits = decode_step(model, params, cache, toks, perrow)
+            toks = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)[:, None]
+        outs[backend] = np.stack(got, axis=1)
+    np.testing.assert_array_equal(outs["fused_layers"], outs["xla"])
+
+
+def test_fused_layers_prefill_falls_back(tiny_model_cfg, params):
+    """Multi-token calls take the per-layer path (the megakernel is
+    single-query by design) and still reproduce the full forward."""
+    model = _variant(tiny_model_cfg, "fused_layers")
+    prompt = jax.random.randint(
+        jax.random.PRNGKey(8), (2, 6), 0, tiny_model_cfg.vocab_size, jnp.int32
+    )
+    full = model.apply({"params": params}, prompt, train=False)
+    cache = init_cache(model, 2)
+    cache, logits = decode_step(model, params, cache, prompt)
+    np.testing.assert_allclose(np.asarray(logits), np.asarray(full), atol=1e-5)
+
+
+def test_supports_gate(tiny_model_cfg):
+    assert decode_fused.supports_fused_layers(tiny_model_cfg)
+    assert not decode_fused.supports_fused_layers(
+        dataclasses.replace(tiny_model_cfg, moe_experts=4, moe_top_k=2)
+    )
+    assert not decode_fused.supports_fused_layers(
+        dataclasses.replace(tiny_model_cfg, max_seq_len=8192)
+    )
+    # t > 1 (prefill) never routes to the megakernel
+    assert not decode_fused.use_fused_layers(
+        dataclasses.replace(tiny_model_cfg, decode_attention="fused_layers"), 4
+    )
+
+
+# ---------------------------------------------------------------------------
+# int8 quantization
+# ---------------------------------------------------------------------------
+
+
+def test_int8_round_trip_error_bound():
+    """Per-element reconstruction error is bounded by half the head's
+    quantization step: |x - deq(q(x))| <= max_head(|x|)/254 (+1 ulp).
+    Zeros round-trip exactly."""
+    h, d = 4, 16
+    x = jax.random.normal(jax.random.PRNGKey(0), (3, 5, h * d), jnp.float32) * 3.0
+    q, scale = quantize_kv(x, h)
+    assert q.dtype == jnp.int8 and scale.shape == (3, 5, h)
+    back = dequantize_kv(q, scale, h, jnp.float32)
+    err = np.abs(np.asarray(back) - np.asarray(x)).reshape(3, 5, h, d)
+    bound = np.asarray(scale)[..., None] / 2.0 * (1.0 + 1e-6)
+    assert (err <= bound).all(), float((err - bound).max())
+    # The pinned global bound: scale = amax/127, so err <= amax/254.
+    amax = np.abs(np.asarray(x)).reshape(3, 5, h, d).max(-1)
+    assert (err <= amax[..., None] / 254.0 * (1.0 + 1e-6)).all()
+    zq, zs = quantize_kv(jnp.zeros((2, 2, h * d)), h)
+    np.testing.assert_array_equal(
+        np.asarray(dequantize_kv(zq, zs, h, jnp.float32)), 0.0
+    )
+
+
+def test_int8_greedy_parity_vs_fp32(tiny_model_cfg, params):
+    """ISSUE 11 acceptance: greedy int8 vs fp32 on the tiny model over 64
+    tokens — match entirely, or measure and pin the first divergence.
+
+    Measured on the committed fixture: FULL 64/64 parity (pinned below;
+    other random seeds can flip argmax near-ties early — random tiny
+    models have ~zero logit margins — which is why the pin names the
+    fixture and PERF.md round 10 documents both facts). The second claim
+    is logit-faithfulness: the per-step logit error stays inside the
+    quantization bound regardless of tie behavior."""
+    # A longer-context twin of the tiny fixture (its max_seq_len=32
+    # cannot hold prompt + 64 tokens); params re-initialized because the
+    # position table's shape follows max_seq_len.
+    cfg = dataclasses.replace(tiny_model_cfg, max_seq_len=128)
+    params = GPT(cfg).init(
+        {"params": jax.random.PRNGKey(7)}, jnp.ones((2, 4), jnp.int32),
+        train=False,
+    )["params"]
+    prompt = jax.random.randint(
+        jax.random.PRNGKey(0), (2, 5), 0, cfg.vocab_size, jnp.int32
+    )
+    n = 64
+    fp32 = np.asarray(generate(_variant(cfg, "xla", "auto"), params, prompt, n))
+    int8 = np.asarray(generate(_variant(cfg, "xla", "int8"), params, prompt, n))
+    matches = (fp32 == int8).all(axis=0)
+    # MEASURED on the committed fixture (params PRNGKey(7), prompt
+    # PRNGKey(0), jax 0.4.37 CPU): full 64/64-token parity — the pinned
+    # claim PERF.md round 10 documents. This is deterministic; if an
+    # intentional quantizer/numerics change moves the first divergence,
+    # re-measure, update PERF.md round 10's parity note, and re-pin here
+    # with the new first-divergence step — never weaken to a vacuous
+    # bound (the acceptance bar is "match, or document the divergence").
+    assert matches.all(), (
+        f"int8 greedy diverged from fp32 at step {int(np.argmin(matches))} "
+        "(committed fixture measured 64/64 — re-measure and re-document "
+        "if this change is intentional)"
+    )
+    # Logit-faithfulness: one decode step from the same prefix must stay
+    # within a small absolute band of fp32 (the quantization error is
+    # bounded; a blow-up here is a kernel bug even when argmax ties flip).
+    m32 = _variant(cfg, "xla", "auto")
+    m8 = _variant(cfg, "xla", "int8")
+    c32, l32 = decode_step(m32, params, init_cache(m32, 2), prompt)
+    c8, l8 = decode_step(m8, params, init_cache(m8, 2), prompt)
+    gap = float(np.abs(np.asarray(l32[:, -1]) - np.asarray(l8[:, -1])).max())
+    assert gap < 0.5, f"int8 prefill logits off by {gap}"
+
+
+def test_int8_kernel_both_grid_flavors_match_dequant_oracle(monkeypatch):
+    """The per-layer fused kernel's in-register dequant, both grid
+    flavors — single-tile and blocked online-softmax (thresholds shrunk
+    to a CPU-interpretable shape, the test_generate.py idiom) — against
+    the whole-cache-dequant + einsum oracle, scalar AND per-row
+    frontiers."""
+    from dtc_tpu.ops import decode_attention as mod
+    from dtc_tpu.ops.attention import decode_attention as oracle
+
+    monkeypatch.setattr(mod, "_DECODE_MAX_SINGLE_S", 128)
+    monkeypatch.setattr(mod, "_DECODE_BLOCK_S", 64)
+    for (b, s, h, d, start) in [
+        (2, 64, 4, 16, 13),       # single-tile, ungrouped heads
+        (2, 128, 4, 32, (127, 90)),  # single-tile, lane-grouped, per-row
+        (2, 256, 2, 8, 100),      # blocked path (s > single-tile max)
+        (2, 256, 4, 32, (100, 255)),  # blocked + lane-grouped + per-row
+    ]:
+        kq, kk, kv = jax.random.split(jax.random.PRNGKey(s), 3)
+        q = jax.random.normal(kq, (b, 1, h * d), jnp.float32)
+        k = jax.random.normal(kk, (b, s, h * d), jnp.float32)
+        v = jax.random.normal(kv, (b, s, h * d), jnp.float32)
+        kq8, ksc = quantize_kv(k, h)
+        vq8, vsc = quantize_kv(v, h)
+        st = jnp.asarray(start, jnp.int32)
+        ref = oracle(
+            q.reshape(b, 1, h, d),
+            dequantize_kv(kq8, ksc, h, jnp.float32).reshape(b, s, h, d),
+            dequantize_kv(vq8, vsc, h, jnp.float32).reshape(b, s, h, d),
+            st,
+        )
+        got = mod.fused_decode_attention(
+            q, kq8, vq8, st, h=h, d=d, k_scale=ksc, v_scale=vsc,
+        ).reshape(b, 1, h, d)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(ref), atol=2e-5,
+            err_msg=f"b={b} s={s} h={h} d={d} start={start}",
+        )
+
+
+def test_int8_pool_capacity_doubles(tiny_model_cfg):
+    """Acceptance: the SAME pool_hbm_bytes budget holds 2× the pages
+    under int8 vs bf16 (4× vs the fp32 default) — quantization buys
+    resident capacity, dtype-aware in the allocator's unit."""
+    from dtc_tpu.serve.paged_cache import kv_token_bytes
+
+    budget = 1 << 20
+    cfgs = {
+        kv: dataclasses.replace(tiny_model_cfg, kv_cache_dtype=kv)
+        for kv in ("float32", "bfloat16", "int8")
+    }
+    tb = {kv: kv_token_bytes(c) for kv, c in cfgs.items()}
+    assert tb["bfloat16"] * 2 == tb["float32"]
+    assert tb["int8"] * 2 == tb["bfloat16"]
+    pools = {}
+    for kv, mcfg in cfgs.items():
+        eng_model = GPT(mcfg)
+        params = eng_model.init(
+            {"params": jax.random.PRNGKey(0)}, jnp.ones((1, 1), jnp.int32),
+            train=False,
+        )["params"]
+        from dtc_tpu.serve.engine import ServingEngine
+
+        eng = ServingEngine(eng_model, params, ServeConfig(
+            slots=2, page_size=8, pool_hbm_bytes=budget,
+        ))
+        pools[kv] = eng.alloc.total_pages
+    assert pools["bfloat16"] == 2 * pools["float32"]
+    assert pools["int8"] == 2 * pools["bfloat16"]
+
+
+def test_pool_sizing_validation():
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        ServeConfig(total_pages=8, pool_hbm_bytes=1 << 20)
+
+
+def test_kv_cache_dtype_aliases():
+    cfg = ModelConfig(
+        vocab_size=97, d_model=64, n_layers=1, n_heads=4, d_ff=128,
+        max_seq_len=32, kv_cache_dtype="bf16",
+    )
+    assert cfg.kv_cache_dtype == "bfloat16"
+    assert ModelConfig(
+        vocab_size=97, d_model=64, n_layers=1, n_heads=4, d_ff=128,
+        max_seq_len=32, kv_cache_dtype="fp32",
+    ).kv_store_dtype == "float32"
+    with pytest.raises(ValueError, match="kv_cache_dtype"):
+        ModelConfig(
+            vocab_size=97, d_model=64, n_layers=1, n_heads=4, d_ff=128,
+            max_seq_len=32, kv_cache_dtype="int4",
+        )
+
+
+# ---------------------------------------------------------------------------
+# serving integration
+# ---------------------------------------------------------------------------
+
+
+def test_engine_fused_layers_int8_lora_matches_generate(tiny_model_cfg):
+    """The full stack at once: megakernel + int8 cache + stacked LoRA
+    under the real scheduler — every output token-identical to solo
+    generate() with the matching adapter."""
+    from dtc_tpu.adapters import init_lora
+    from dtc_tpu.serve import Request, RequestState, ServingEngine
+
+    cfg = dataclasses.replace(
+        tiny_model_cfg, decode_attention="fused_layers", kv_cache_dtype="int8",
+        adapter=AdapterConfig(rank=2, alpha=4.0),
+    )
+    model = GPT(cfg)
+    params = model.init(
+        {"params": jax.random.PRNGKey(7)}, jnp.ones((2, 4), jnp.int32),
+        train=False,
+    )["params"]
+    factors = jax.tree.map(lambda a: a + 0.05, init_lora(model, seed=1))
+    rng = np.random.RandomState(5)
+    prompts = [rng.randint(0, cfg.vocab_size, size=n).tolist() for n in (5, 7, 6)]
+    refs = [
+        np.asarray(generate(
+            model, params, jnp.asarray(prompts[0], jnp.int32)[None], 6,
+            lora=factors,
+        ))[0].tolist(),
+        np.asarray(generate(
+            model, params, jnp.asarray(prompts[1], jnp.int32)[None], 6,
+        ))[0].tolist(),
+        np.asarray(generate(
+            model, params, jnp.asarray(prompts[2], jnp.int32)[None], 6,
+        ))[0].tolist(),
+    ]
+    eng = ServingEngine(model, params, ServeConfig(
+        slots=3, page_size=4, queue_depth=8, max_new_tokens=6,
+        prefill_bucket=8, max_adapters=4,
+    ))
+    eng.load_adapter("t1", factors)
+    eng.submit(Request(rid="r0", prompt=prompts[0], max_new_tokens=6,
+                       adapter="t1"))
+    eng.submit(Request(rid="r1", prompt=prompts[1], max_new_tokens=6))
+    eng.submit(Request(rid="r2", prompt=prompts[2], max_new_tokens=6))
+    res = eng.run(max_steps=100)
+    for i in range(3):
+        r = res[f"r{i}"]
+        assert r.state is RequestState.DONE
+        assert r.tokens == refs[i], f"r{i}: {r.tokens} != {refs[i]}"
+
+
+def test_engine_int8_corruption_detected_and_healed(tiny_model_cfg):
+    """The page-checksum verifier and evict→re-prefill recovery stay
+    green on an int8 cache (dtype-aware fingerprints): an injected
+    corrupted page is detected and the damaged request completes
+    token-identically to a clean run."""
+    from dtc_tpu.config.schema import ChaosConfig
+    from dtc_tpu.serve import Request, RequestState, ServingEngine
+
+    cfg = dataclasses.replace(tiny_model_cfg, kv_cache_dtype="int8")
+    model = GPT(cfg)
+    params = model.init(
+        {"params": jax.random.PRNGKey(7)}, jnp.ones((2, 4), jnp.int32),
+        train=False,
+    )["params"]
+    rng = np.random.RandomState(9)
+    prompt = rng.randint(0, cfg.vocab_size, size=6).tolist()
+
+    def run(chaos):
+        eng = ServingEngine(model, params, ServeConfig(
+            slots=2, page_size=4, queue_depth=8, max_new_tokens=8,
+            prefill_bucket=8, verify_pages_every=1, chaos=chaos,
+        ))
+        eng.submit(Request(rid="a", prompt=prompt, max_new_tokens=8))
+        return eng, eng.run(max_steps=200)["a"]
+
+    clean_eng, clean = run(ChaosConfig())
+    chaos_eng, faulted = run(ChaosConfig(
+        enabled=True, serve_corrupt_page_at_step=2,
+    ))
+    assert clean.state is RequestState.DONE
+    assert faulted.state is RequestState.DONE
+    assert faulted.tokens == clean.tokens
+    snap = chaos_eng.reg.snapshot()
+    assert snap.get("serve_corruptions", 0) >= 1, (
+        "int8 fingerprints never detected the injected corruption"
+    )
+    assert faulted.n_evictions >= 1

@@ -205,6 +205,41 @@ def test_decode_step_bytes_components_and_batch_amortization():
     )
 
 
+def test_decode_step_bytes_int8_branch_hand_computed():
+    """ISSUE 11: the dtype-aware KV byte model. int8 moves the 1-byte
+    payload PLUS the per-(position, head) fp32 scales; float overrides
+    move payload-only at their element size. Weights/activations are
+    untouched by the cache dtype."""
+    hd = H * (D // H)
+    for kv, expect_pos in (
+        ("bfloat16", 2.0 * hd * 2),                  # payload only
+        ("float32", 2.0 * hd * 4),
+        ("int8", 2.0 * hd * 1 + 2.0 * H * 4.0),      # payload + scales
+    ):
+        cfg = _cfg(param_dtype="float32", compute_dtype="bfloat16",
+                   kv_cache_dtype=kv)
+        got = decode_step_bytes(cfg, 8, 16)
+        assert got["kv_read"] == pytest.approx(L * 16 * expect_pos * 8), kv
+        assert got["kv_write"] == pytest.approx(L * expect_pos * 8), kv
+    # "auto" remains byte-identical to the legacy compute-dtype model.
+    auto = decode_step_bytes(
+        _cfg(param_dtype="float32", compute_dtype="bfloat16"), 8, 16
+    )
+    bf16 = decode_step_bytes(
+        _cfg(param_dtype="float32", compute_dtype="bfloat16",
+             kv_cache_dtype="bfloat16"), 8, 16
+    )
+    assert auto == bf16
+    # The headline ratio: int8 cuts the KV term ~2x vs bf16 (slightly
+    # less than exact 2x — the scale sidecars are counted honestly).
+    int8 = decode_step_bytes(
+        _cfg(param_dtype="float32", compute_dtype="bfloat16",
+             kv_cache_dtype="int8"), 8, 16
+    )
+    ratio = bf16["kv_read"] / int8["kv_read"]
+    assert 1.5 < ratio < 2.0
+
+
 def test_decode_roofline_is_bytes_over_bandwidth():
     cfg = _cfg()
     total = decode_step_bytes(cfg, 8, 16)["total"]
