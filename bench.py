@@ -395,6 +395,103 @@ def devprof_bench(capture_steps: int = 3) -> dict:
     }
 
 
+def fsdp_overlap_bench(
+    collectives: str = "xla", batch: int = 8, bench_steps: int = 20,
+    capture_steps: int = 2,
+) -> dict:
+    """One leg of the ISSUE 12 A/B: the flagship train step under
+    ``parallel: fsdp`` over ALL local devices with ``collectives`` set,
+    timed (tokens/s) AND devprof-captured for the comm/compute
+    ``overlap_ratio`` — the ROADMAP item-2 headline number (xla leg
+    measures 0.0 by construction; the overlapped leg's target is ≥0.5).
+
+    Same-config drift rule (the PR 10 pattern): the row carries
+    ``collectives``/``platform``/``devices``, and the guard only compares
+    rows whose config matches. Requires a real ring: on a single-device
+    platform (the tunneled 1-chip TPU, a plain CPU) this raises — the
+    row then records the error and stays wired-but-unmeasured, never a
+    fake number."""
+    import jax
+    import numpy as np
+    from flax import linen as nn
+
+    from dtc_tpu.obs import devprof
+    from dtc_tpu.utils.metrics import (
+        comm_bytes_per_step, gpt_step_flops, mfu, peak_flops_per_chip,
+    )
+    from scripts.bench_common import build_step
+
+    if jax.device_count() < 2:
+        raise RuntimeError(
+            "fsdp_overlap_ab needs >= 2 devices (an FSDP ring of 1 is "
+            "inert); run on a multi-chip slice"
+        )
+    step_fn, state, batch_obj, key, (mesh, rules), model_cfg = build_step(
+        batch=batch, remat=False, parallel="fsdp", collectives=collectives,
+    )
+    import tempfile
+
+    with tempfile.TemporaryDirectory(prefix="dtc_fsdp_overlap_") as trace_dir:
+        with mesh, nn.logical_axis_rules(rules):
+            rng = jax.random.fold_in(key, 0)
+            compiled = step_fn.lower(state, batch_obj, rng).compile()
+            hlo_text = compiled.as_text()
+            out = compiled(state, batch_obj, rng)
+            jax.block_until_ready(out[1])
+            for i in range(4):  # warmup
+                out = compiled(out[0], batch_obj, rng)
+            float(np.asarray(out[1]))
+            start = time.perf_counter()
+            for _ in range(bench_steps):
+                out = compiled(out[0], batch_obj, rng)
+            float(np.asarray(out[1]))
+            elapsed = time.perf_counter() - start
+            comm = comm_bytes_per_step(
+                model_cfg, batch, model_cfg.max_seq_len,
+                {k: int(v) for k, v in mesh.shape.items()}, "fsdp",
+            )
+            with devprof.CaptureWindow(
+                trace_dir, steps=capture_steps, reason="fsdp_overlap_ab",
+                step_flops=gpt_step_flops(model_cfg, batch, model_cfg.max_seq_len),
+                peak_flops=peak_flops_per_chip(),
+                comm_estimate=comm,
+            ) as cap:
+                for _ in range(capture_steps):
+                    out = compiled(out[0], batch_obj, rng)
+                jax.block_until_ready(out[1])
+        analysis = (
+            devprof.analyze_capture(trace_dir, hlo_text=hlo_text)
+            if cap.ok else None
+        )
+        att = analysis["attribution"] if analysis else None
+    step_time = elapsed / bench_steps
+    u = mfu(model_cfg, batch, model_cfg.max_seq_len, step_time,
+            jax.device_count())
+    res = {
+        "collectives": collectives,
+        "platform": jax.default_backend(),
+        "devices": jax.device_count(),
+        "step_time_s": round(step_time, 5),
+        "tokens_per_sec": round(batch * model_cfg.max_seq_len / step_time, 1),
+        "mfu": round(u, 4) if u is not None else None,
+        "final_loss": round(float(np.asarray(out[1])), 4),
+        "comm_bytes_per_step": round(comm["total"]),
+    }
+    if att is not None:
+        res.update(
+            overlap_ratio=round(att.overlap_ratio, 4),
+            collective_ms_per_step=round(
+                att.collective_s / capture_steps * 1e3, 4
+            ),
+            fused_collective_ms_per_step=round(
+                att.fused_collective_s / capture_steps * 1e3, 4
+            ),
+        )
+    else:
+        res["overlap_ratio"] = None  # capture failed: timing still real
+    return res
+
+
 def serve_bench(
     rps: float | None,
     *,
@@ -704,61 +801,46 @@ def decode_drift_guard(extra: dict, repo_dir: str | None = None) -> list[str]:
     if not paths:
         return flags
 
-    def has_rows(detail: dict, prefix: str) -> bool:
-        return any(
-            label.startswith(prefix) and isinstance(row, dict)
-            and "ms_per_token" in row
-            for label, row in detail.items()
-        )
+    def compare(prefix: str, metric: str, comparable) -> None:
+        """One guarded row family: walk committed files newest-first,
+        stop at the first file holding at least one COMPARABLE row —
+        a newest file whose rows are all incomparable (different
+        platform/model/config, e.g. TPU rows committed during a CPU
+        round) must not deactivate the guard while an older comparable
+        file exists — and flag metric regressions > 20%.
+        ``comparable(old, row)`` is the family's same-config rule."""
 
-    def compare(prefix: str) -> None:
-        if not any(
-            isinstance(r, dict) and l.startswith(prefix) and "ms_per_token" in r
-            for l, r in extra.items()
-        ):
+        def has_rows(detail: dict) -> bool:
+            return any(
+                label.startswith(prefix) and isinstance(row, dict)
+                and metric in row
+                for label, row in detail.items()
+            )
+
+        if not has_rows(extra):
             return  # this run measured no such rows: nothing to guard
-        # Walk files newest-first and stop at the first one with at least
-        # one COMPARABLE row — a newest file whose rows are all
-        # incomparable (different platform/serve model, e.g. TPU rows
-        # committed during a CPU round) must not deactivate the guard
-        # while an older comparable file exists.
         for path in reversed(paths):
             prev = _bench_detail(path)
-            if not has_rows(prev, prefix):
+            if not has_rows(prev):
                 continue
             compared = False
             for label, row in extra.items():
                 if not (isinstance(row, dict) and label.startswith(prefix)):
                     continue
                 old = prev.get(label)
-                if not (isinstance(old, dict) and "ms_per_token" in old):
+                if not (isinstance(old, dict) and metric in old):
                     continue
-                if prefix == "serve" and (
-                    old.get("platform") != row.get("platform")
-                    or old.get("serve_model") != row.get("serve_model")
-                ):
-                    # Committed on different hardware, or measured with a
-                    # different serve model (tiny vs flagship rows share
-                    # labels): not comparable.
-                    continue
-                # Same-config rule: decode_attention/kv_cache_dtype must
-                # match (pre-ISSUE-11 rows lack the fields and ran the
-                # then-only config — normalize so history stays guarded).
-                cfg_of = lambda r: (  # noqa: E731
-                    r.get("decode_attention", "fused"),
-                    r.get("kv_cache_dtype", "auto"),
-                )
-                if cfg_of(old) != cfg_of(row):
+                if not comparable(old, row):
                     continue
                 compared = True
-                new_ms, old_ms = row.get("ms_per_token"), old["ms_per_token"]
+                new_v, old_v = row.get(metric), old[metric]
                 if (
-                    isinstance(new_ms, (int, float)) and isinstance(old_ms, (int, float))
-                    and new_ms and old_ms and new_ms > 1.2 * old_ms
+                    isinstance(new_v, (int, float)) and isinstance(old_v, (int, float))
+                    and new_v and old_v and new_v > 1.2 * old_v
                 ):
                     flags.append(
-                        f"{label}: {new_ms} ms/token vs {old_ms} in "
-                        f"{os.path.basename(path)} (+{(new_ms / old_ms - 1) * 100:.0f}%)"
+                        f"{label}: {new_v} {metric} vs {old_v} in "
+                        f"{os.path.basename(path)} (+{(new_v / old_v - 1) * 100:.0f}%)"
                     )
             if compared:
                 return
@@ -769,8 +851,27 @@ def decode_drift_guard(extra: dict, repo_dir: str | None = None) -> list[str]:
                 "this run)"
             )
 
-    compare("decode")
-    compare("serve")
+    # Same-config rule per family. Decode: decode_attention/kv_cache_dtype
+    # must match (pre-ISSUE-11 rows lack the fields and ran the then-only
+    # config — normalize so history stays guarded). Serve: additionally
+    # same platform AND serve model (tiny vs flagship rows share labels;
+    # the committed scheduler rows are CPU-measured under the TPU-tunnel
+    # outage). fsdp_overlap (ISSUE 12): collectives/platform/devices must
+    # all match — an overlapped row must never be judged against an xla
+    # row, nor a multi-chip row against a 1-chip one.
+    def decode_cfg(r):
+        return (r.get("decode_attention", "fused"), r.get("kv_cache_dtype", "auto"))
+
+    compare("decode", "ms_per_token", lambda o, r: decode_cfg(o) == decode_cfg(r))
+    compare("serve", "ms_per_token", lambda o, r: (
+        decode_cfg(o) == decode_cfg(r)
+        and o.get("platform") == r.get("platform")
+        and o.get("serve_model") == r.get("serve_model")
+    ))
+    compare("fsdp_overlap", "step_time_s", lambda o, r: all(
+        o.get(k) == r.get(k) for k in ("collectives", "platform", "devices")
+    ))
+
     if flags:
         extra["decode_regressions"] = flags
     return flags
@@ -1053,6 +1154,15 @@ def main(argv: list[str] | None = None) -> None:
     # for the b8 reference step, gated structurally (every dot attributed,
     # unattributed share bounded) with the census cross-check.
     emit("devprof_b8", _safe("devprof_b8", devprof_bench))
+    # Overlapped-collectives A/B (ISSUE 12): the SAME fsdp config with
+    # collectives xla vs overlapped — tokens/s plus the devprof
+    # overlap_ratio (ROADMAP item 2's 0.0 -> >=0.5 headline). Needs a
+    # multi-chip slice; on the 1-chip tunnel both legs record the typed
+    # error (wired-but-unmeasured, PERF.md round 11).
+    emit("fsdp_overlap_ab_xla", _safe("fsdp_overlap_ab_xla",
+         lambda: fsdp_overlap_bench(collectives="xla")))
+    emit("fsdp_overlap_ab_overlapped", _safe("fsdp_overlap_ab_overlapped",
+         lambda: fsdp_overlap_bench(collectives="overlapped")))
     emit("ring_block_smoke", _safe("ring_block_smoke", ring_block_smoke))
 
     # Assemble the detail line FROM the registry's event stream: each

@@ -82,6 +82,63 @@ def _buffer_bytes(type_text: str) -> int:
     return total
 
 
+#: result-type capture for a custom-call line (the Pallas lowering:
+#: kernels land as ``custom-call(...), custom_call_target="tpu_custom_call"``).
+_CC_RESULT = re.compile(r"%[\w.-]+ = (.*?) custom-call\(")
+
+#: the XLA:TPU custom-call target every Pallas kernel lowers to.
+PALLAS_CUSTOM_CALL_TARGET = "tpu_custom_call"
+
+#: kernel-name tokens of the ISSUE 12 overlap ring kernels
+#: (ops/overlap_collectives.py). The lowering stamps the kernel function
+#: name onto the custom-call line (``kernel_name = "_overlap_ag_..."``),
+#: which is what lets the census tell RING transport apart from every
+#: other Pallas kernel in the module (flash attention, decode, MoE) —
+#: accepting any tpu_custom_call would make the overlapped entries'
+#: required-transport check vacuous on TPU.
+OVERLAP_KERNEL_TOKENS = ("overlap_ag_matmul", "overlap_rs_matmul")
+
+
+def overlap_kernel_custom_calls(txt: str) -> dict[str, int]:
+    """``{"count": n, "bytes": b}`` over the overlap RING kernels only —
+    tpu_custom_call lines carrying an OVERLAP_KERNEL_TOKENS name. If a
+    backend ever stops printing kernel names in HLO text, this returns 0
+    and the overlapped census check FAILS LOUDLY (the right direction:
+    a parser gap must never read as 'ring present')."""
+    count = tot = 0
+    for line in txt.splitlines():
+        if f'custom_call_target="{PALLAS_CUSTOM_CALL_TARGET}"' not in line:
+            continue
+        if not any(tok in line for tok in OVERLAP_KERNEL_TOKENS):
+            continue
+        count += 1
+        m = _CC_RESULT.search(line)
+        if m:
+            tot += _buffer_bytes(m.group(1))
+    return {"count": count, "bytes": tot}
+
+
+def pallas_custom_calls(txt: str) -> dict[str, int]:
+    """``{"count": n, "bytes": b}`` over the module's Pallas custom-calls
+    (``tpu_custom_call`` targets; bytes sum each call's result buffers).
+
+    The overlapped-collectives kernels (ops/overlap_collectives.py,
+    ISSUE 12) move the FSDP ring INSIDE fused kernels, so a TPU lowering
+    of the overlapped step has no named all-gather/reduce-scatter
+    instructions to census — this is the fingerprint the census rules
+    accept in their place (remote-copy DMAs never lower to named HLO
+    collectives)."""
+    count = tot = 0
+    for line in txt.splitlines():
+        if f'custom_call_target="{PALLAS_CUSTOM_CALL_TARGET}"' not in line:
+            continue
+        count += 1
+        m = _CC_RESULT.search(line)
+        if m:
+            tot += _buffer_bytes(m.group(1))
+    return {"count": count, "bytes": tot}
+
+
 def collective_counts(txt: str) -> Counter:
     """Per-op instruction counts — the round-5 test's ``_collectives``."""
     return Counter(_INSTR.findall(txt))
@@ -103,6 +160,14 @@ def collective_census(txt: str) -> dict[str, dict[str, int]]:
         row = census.setdefault(op, {"count": 0, "bytes": 0})
         row["count"] += 1
         row["bytes"] += _buffer_bytes(type_text)
+    # Pallas kernels (ISSUE 12 overlapped collectives): counted as their
+    # own census row — remote-copy kernels never lower to named HLO
+    # collective ops, and a census blind to them would read an overlapped
+    # TPU module as collective-free. Row omitted when zero, so every
+    # pre-existing (kernel-free) baseline stays byte-identical.
+    cc = pallas_custom_calls(txt)
+    if cc["count"]:
+        census["pallas_custom_call"] = cc
     return census
 
 
@@ -117,6 +182,35 @@ def all_gather_shapes(txt: str) -> list[str]:
         f"{d}[{','.join(str(x) for x in dims)}]"
         for d, dims in all_gather_dims(txt)
     ]
+
+
+#: op_name metadata on an instruction line (XLA records the named-scope
+#: path of the op that produced/consumes the instruction).
+_LINE_OP_NAME = re.compile(r'op_name="([^"]+)"')
+
+
+def all_gather_entries(
+    txt: str,
+) -> list[tuple[str, tuple[int, ...], str]]:
+    """(dtype, dims, op_name) of every all-gather result buffer — the
+    scope-aware form of :func:`all_gather_dims` (op_name '' when the
+    instruction carries no metadata). The overlapped-collectives rule
+    keys on the SCOPE: a rank-2+ gather whose op_name path runs through
+    the layer scan ("/blocks/") is serialized per-layer traffic the ring
+    should have replaced, while shape-identical gathers at the head/embed
+    are legitimate (shape-only matching false-positives on the tiny
+    audit model, where lm_head == fc1 shapes — see rules.py)."""
+    out = []
+    for line in txt.splitlines():
+        m = _RESULT.search(line)
+        if not m or m.group(2) != "all-gather":
+            continue
+        scope_m = _LINE_OP_NAME.search(line)
+        scope = scope_m.group(1) if scope_m else ""
+        for d, dims_txt in _BUFFER.findall(m.group(1)):
+            dims = tuple(int(x) for x in dims_txt.split(",")) if dims_txt else ()
+            out.append((d, dims, scope))
+    return out
 
 
 def all_gather_dims(txt: str) -> list[tuple[str, tuple[int, ...]]]:

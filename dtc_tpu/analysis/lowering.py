@@ -108,6 +108,23 @@ TRAIN_ENTRIES: dict[str, EntrySpec] = {
         "ulysses", "3d", MeshConfig(pipe=1, data=2, model=4),
         dict(attention="ulysses"), "ring",
     ),
+    # ISSUE 12 — overlapped training collectives. Two audited flavors:
+    # the pure-FSDP ring (the b8 reference's mesh) and the first-class
+    # DP×FSDP×TP "3d" mode (configs/train_config_3d.yaml). On this CPU
+    # the op resolves to the decomposed transport, so the baselines pin
+    # the collective-permute ring census + the ABSENCE of the per-layer
+    # kernel all-gathers it replaces; TPU lowerings carry the Pallas
+    # custom-calls instead (rules.py accepts either fingerprint, and
+    # tests/test_overlap_collectives.py pins the tpu_custom_call via
+    # jax.export).
+    "fsdp_overlapped": EntrySpec(
+        "fsdp_overlapped", "fsdp", MeshConfig(),
+        dict(collectives="overlapped"), "fsdp",
+    ),
+    "3d": EntrySpec(
+        "3d", "fsdp", MeshConfig(pipe=1, data=4, model=2),
+        dict(collectives="overlapped"), "fsdp",
+    ),
 }
 
 _RULE_TABLES = {

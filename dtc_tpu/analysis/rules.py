@@ -62,7 +62,25 @@ REQUIRED_COLLECTIVES: dict[str, tuple[str, ...]] = {
     "train_ep": ("all-to-all",),
     "train_ep_sort": ("all-to-all",),
     "train_ulysses": ("all-to-all",),
+    # The DP×FSDP×TP overlapped mode keeps the Megatron activation
+    # all-reduces (the explicit psums); its ring transport is checked
+    # separately below.
+    "train_3d": ("all-reduce",),
 }
+
+#: ISSUE 12 entries whose FSDP traffic rides the overlap ring: the
+#: census must see the ring TRANSPORT — collective-permute (decomposed /
+#: CPU lowering) or the Pallas custom-calls (fused TPU kernels; the
+#: remote-copy DMAs never lower to named HLO collectives) — and must NOT
+#: see the serialized per-layer kernel all-gathers the ring replaces.
+OVERLAPPED_ENTRIES = ("train_fsdp_overlapped", "train_3d")
+
+#: census ops that can carry an overlapped entry's ring traffic at the
+#: XLA level; the fused-kernel form is checked via
+#: ``hlo.overlap_kernel_custom_calls`` (kernel-NAME matched — a generic
+#: tpu_custom_call count would be satisfied by flash/decode kernels and
+#: make the check vacuous on TPU).
+RING_TRANSPORT_OPS = ("collective-permute",)
 
 #: Census-bytes vs comm_bytes_per_step cross-check tolerance (ratio band).
 CROSS_CHECK_BAND = (1 / 8, 8.0)
@@ -104,6 +122,25 @@ def audit_census(a: Artifact) -> list[Finding]:
                 f"instruction nor the all-reduce+partition-id decomposition "
                 f"is present; census: {counts})",
             ))
+    if a.name in OVERLAPPED_ENTRIES:
+        # Both the param gathers AND the grad reduce-scatter ride the
+        # ring here: the transport must be present in one of its two
+        # lowered forms — collective-permute (decomposed) or the overlap
+        # KERNELS' custom-calls (name-matched; any other Pallas kernel
+        # does not count) — or the overlap silently degraded to a
+        # replicated program.
+        ring_kernels = hlo.overlap_kernel_custom_calls(a.hlo_text)
+        if not (
+            any(counts.get(op, 0) for op in RING_TRANSPORT_OPS)
+            or ring_kernels["count"]
+        ):
+            out.append(_err(
+                "census.required_collective", a.name,
+                f"{a.name} lost its overlap ring — neither "
+                "collective-permute (decomposed transport) nor the "
+                "overlap ring kernels' custom-calls are present "
+                f"(census: {counts})",
+            ))
 
     out.extend(_audit_gathers(a))
     out.extend(_cross_check_bytes(a, census))
@@ -131,19 +168,54 @@ def _audit_gathers(a: Artifact) -> list[Finding]:
                 f"{[f'{d}{list(dims)}' for d, dims in bad[:4]]}",
             ))
     if a.parallel == "fsdp":
-        # Inside FSDP, per-layer rank-2 gathers at use are the design;
-        # a rank-3 gather with the stacked n_layers leading axis means XLA
-        # hoisted the whole parameter out of the layer scan and the ZeRO
-        # memory win is gone.
-        stacked = [
-            (d, dims) for d, dims in gathers
-            if len(dims) >= 3 and dims[0] == a.n_layers
-        ]
+        # Inside FSDP, per-layer rank-2 gathers at use are the design; a
+        # gather landing EXACTLY a stacked param's full (L, ...) shape
+        # means XLA hoisted the whole parameter out of the layer scan and
+        # the ZeRO memory win is gone. (dtype, dims) membership, not a
+        # bare leading-dim test: incidental rank-3 buffers (the wte
+        # scatter-add's s32 index gather) can share the leading dim with
+        # n_layers on small meshes (ISSUE 12 found it at data=4). The
+        # accepted dtypes are the param dtype AND the model's compute
+        # dtype — XLA routinely sinks the fp32->bf16 convert below the
+        # gather to halve wire bytes, so a hoisted gather may land the
+        # CAST of a stacked param.
+        hlo_compute = {
+            "float32": "f32", "bfloat16": "bf16", "float16": "f16",
+        }.get(a.compute_dtype, "f32")
+        stacked_shapes = set()
+        for d, dims in a.param_shapes:
+            if len(dims) >= 3 and dims[0] == a.n_layers:
+                stacked_shapes.add((d, dims))
+                stacked_shapes.add((hlo_compute, dims))
+        stacked = [g for g in gathers if g in stacked_shapes]
         if stacked:
             out.append(_err(
                 "census.stacked_param_gather", a.name,
                 "full stacked-parameter all-gather(s) outside the FSDP "
                 f"layer scan: {[f'{d}{list(dims)}' for d, dims in stacked[:4]]}",
+            ))
+    if a.name in OVERLAPPED_ENTRIES:
+        # The whole point of the mode: the serialized per-layer gathers
+        # must be GONE from the layer scan (replaced by the ring). Keyed
+        # on the gathers' op_name SCOPE, not shapes: shape matching
+        # false-positives on the tiny audit model (lm_head's TP-local
+        # (64,64) == q_proj's per-layer shape), while the scope is
+        # unambiguous — a healthy overlapped module's only "/blocks/"
+        # gathers are the rank-1 bias/LN assemblies, and a degraded one
+        # shows rank-2 kernel gathers OR rank-3 activation gathers there
+        # (XLA serializes FSDP either way; both are forbidden).
+        bad = [
+            (d, dims, scope)
+            for d, dims, scope in hlo.all_gather_entries(a.hlo_text)
+            if "/blocks/" in scope and len(dims) >= 2
+        ]
+        if bad:
+            out.append(_err(
+                "census.serialized_layer_gather", a.name,
+                "overlapped mode still emits serialized layer-scan "
+                "all-gather(s): "
+                f"{[(f'{d}{list(dims)}', s.split('/')[-1]) for d, dims, s in bad[:4]]}"
+                " — the ring did not take these matmuls over",
             ))
     if a.moe_experts > 0:
         # EP: a gather landing a full leading-E expert tensor (B,E,...) or
@@ -173,21 +245,36 @@ def _cross_check_bytes(a: Artifact, census: dict) -> list[Finding]:
     bytes are 100x off the gradient estimate is structurally wrong in a
     way the presence checks cannot see."""
     est = a.comm_estimate or {}
-    checks: list[tuple[str, tuple[str, ...], float]] = []
+    checks: list[tuple[str, tuple[str, ...], float, float]] = []
     if est.get("dp_allreduce"):
+        dp_ops: tuple[str, ...] = ("all-reduce", "reduce-scatter", "all-gather")
+        extra_bytes = 0.0
+        if a.name in OVERLAPPED_ENTRIES:
+            # The FSDP bytes ride the ring transport in this mode — the
+            # cross-check must count them or every overlapped entry would
+            # warn vacuously (the estimator models the same wire bytes
+            # re-phased, not removed). Fused-kernel bytes are matched by
+            # kernel NAME so foreign Pallas kernels (flash/decode) never
+            # pollute the measurement.
+            dp_ops = dp_ops + RING_TRANSPORT_OPS
+            extra_bytes = float(
+                hlo.overlap_kernel_custom_calls(a.hlo_text)["bytes"]
+            )
         checks.append((
-            "dp_allreduce", ("all-reduce", "reduce-scatter", "all-gather"),
-            est["dp_allreduce"],
+            "dp_allreduce", dp_ops,
+            est["dp_allreduce"], extra_bytes,
         ))
     if est.get("tp_allreduce"):
         checks.append((
             "tp_allreduce", ("all-reduce", "all-gather", "all-to-all"),
-            est["tp_allreduce"],
+            est["tp_allreduce"], 0.0,
         ))
     out: list[Finding] = []
     lo, hi = CROSS_CHECK_BAND
-    for label, ops, estimate in checks:
-        measured = float(sum(census.get(op, {}).get("bytes", 0) for op in ops))
+    for label, ops, estimate, extra in checks:
+        measured = extra + float(
+            sum(census.get(op, {}).get("bytes", 0) for op in ops)
+        )
         if measured == 0:
             continue  # presence checks already cover a missing collective
         ratio = measured / estimate

@@ -158,6 +158,26 @@ class ModelConfig:
     # (ServeConfig.pool_hbm_bytes); greedy parity vs fp32 is measured in
     # tests/test_decode_fused.py and PERF.md round 10.
     kv_cache_dtype: str = "auto"
+    # Training-collectives execution strategy (ops/overlap_collectives.py,
+    # ISSUE 12): "xla" (default) leaves every FSDP parameter all-gather /
+    # gradient reduce-scatter to the SPMD partitioner, which serializes
+    # them against the matmuls (measured overlap_ratio 0.0 — ROADMAP item
+    # 2); "overlapped" routes the per-layer dense matmuls through explicit
+    # ring schedules (Pallas make_async_remote_copy kernels on TPU,
+    # ppermute decomposition elsewhere) so each shard's transfer hides
+    # under the previous shard's MXU time. Auto-falls back to the plain
+    # dot for shapes/meshes the rings don't support (no FSDP axis in the
+    # active rules, ring of 1, non-divisible tails, eager init) — so the
+    # knob is safe on any config; it only changes programs whose rules
+    # shard "embed_p". Normally set via TrainConfig.collectives (the
+    # trainer lifts it onto the model config — train/train_step.py
+    # resolve_collectives). Dropout caveat: under the LEGACY threefry
+    # (jax_threefry_partitionable=False) random bits are sharding-layout-
+    # dependent, so with dropout > 0 the two modes draw different —
+    # equally valid — masks (the 1F1B-vs-GPipe dropout semantics);
+    # trajectories coincide under partitionable threefry (pinned in
+    # tests/test_overlap_collectives.py) and at dropout 0 everywhere.
+    collectives: str = "xla"
     # Dev knob: emit checkify.check guards for traced invariants that
     # cannot raise at trace time (currently the decode-cache write
     # frontier, whose dynamic_update_slice would otherwise CLAMP on
@@ -197,6 +217,12 @@ class ModelConfig:
             raise ValueError(
                 f"unknown decode_attention {self.decode_attention!r}; "
                 "expected 'fused_layers', 'fused' or 'xla'"
+            )
+        if self.collectives not in ("xla", "overlapped"):
+            raise ValueError(
+                f"unknown collectives {self.collectives!r}; expected "
+                "'xla' (serialized GSPMD collectives) or 'overlapped' "
+                "(ring all-gather-matmul + streamed grad reduce-scatter)"
             )
         # Normalize the kv-cache dtype aliases BEFORE validating, so YAML
         # configs may say fp32/bf16 (the knob-doc spelling) while every
@@ -718,6 +744,14 @@ class TrainConfig:
     # Megatron-style: V model chunks per device shrink the fill bubble to
     # chunk-sized steps. Requires n_layers % (pipe * virtual) == 0.
     pp_virtual_stages: int = 1
+    # Training-collectives strategy: "xla" (serialized — the partitioner's
+    # schedule) or "overlapped" (Pallas ring all-gather-matmul + streamed
+    # grad reduce-scatter for the FSDP axis — see ModelConfig.collectives;
+    # the trainer lifts this onto the model config via
+    # train/train_step.resolve_collectives). Meaningful for parallel:
+    # fsdp (including DP×FSDP×TP meshes — configs/train_config_3d.yaml);
+    # inert elsewhere, rejected under pipeline parallelism.
+    collectives: str = "xla"
     mesh: MeshConfig = field(default_factory=MeshConfig)
     dataset: str = "fineweb"     # fineweb | synthetic
     warmup_steps: int = 5        # untimed warmup steps (reference uses 5)
@@ -786,6 +820,11 @@ class TrainConfig:
             raise ValueError(
                 "pp_virtual_stages > 1 (interleaved scheduling) requires "
                 "pp_schedule: 1f1b"
+            )
+        if self.collectives not in ("xla", "overlapped"):
+            raise ValueError(
+                f"unknown collectives {self.collectives!r}; expected "
+                "'xla' or 'overlapped'"
             )
         if self.eval_holdout_every < 1:
             raise ValueError("eval_holdout_every must be >= 1")

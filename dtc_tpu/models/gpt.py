@@ -46,6 +46,74 @@ def _dtype(name: str):
     return {"float32": jnp.float32, "bfloat16": jnp.bfloat16, "float16": jnp.float16}[name]
 
 
+class OverlapDense(nn.Module):
+    """``nn.Dense`` twin whose matmul rides the overlapped-collectives
+    ring (ops/overlap_collectives.py, ISSUE 12).
+
+    Same parameter tree, names, shapes, and init as ``nn.Dense`` — so the
+    sharding rule table, checkpoints, and LoRA injection see an identical
+    layer — but the product is computed by the fused
+    all-gather-then-matmul whenever the active rules shard "embed_p"
+    (FSDP): each ring step matmuls the parameter shard already on-chip
+    while the next shard streams in, and the backward pass streams the
+    weight-gradient reduce-scatter through the ring the same way.
+    ``shard_axis`` names which KERNEL axis carries "embed_p" under
+    FSDP_RULES: 0 for the contraction axis (q/k/v/fc1 — d_model in), 1
+    for the output axis (out_proj/fc2 — d_model out); ``tp_logical`` is
+    the logical axis of the OTHER kernel dimension ("qkv" / "mlp"), so on
+    a DP×FSDP×TP mesh the op goes manual over the Megatron axis too and
+    makes its row-parallel psums explicit. Every inapplicable call (no
+    FSDP axis in scope, eager init, decode's narrow batches,
+    non-divisible tails) falls back to the identical plain dot inside the
+    op, so selecting ``collectives: overlapped`` is safe on any config.
+    """
+
+    features: int
+    shard_axis: int
+    tp_logical: str = "qkv"
+    dtype: Any = None
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        from flax.linen import dtypes
+
+        from dtc_tpu.ops.overlap_collectives import overlap_dense_matmul
+        from dtc_tpu.parallel.sharding import fsdp_axis_in_scope
+
+        kernel = self.param(
+            "kernel", nn.initializers.lecun_normal(),
+            (x.shape[-1], self.features), self.param_dtype,
+        )
+        bias = self.param(
+            "bias", nn.initializers.zeros_init(), (self.features,),
+            self.param_dtype,
+        )
+        x, kernel, bias = dtypes.promote_dtype(
+            x, kernel, bias, dtype=self.dtype
+        )
+        tp_axis = dict(nn.get_logical_axis_rules()).get(self.tp_logical)
+        y = overlap_dense_matmul(
+            x, kernel, shard_axis=self.shard_axis,
+            axis_name=fsdp_axis_in_scope(),
+            tp_axis=tp_axis if isinstance(tp_axis, str) else None,
+        )
+        return y + bias
+
+
+def _dense(cfg: ModelConfig, features: int, name: str, shard_axis: int,
+           cdtype, pdtype, tp_logical: str = "qkv") -> nn.Module:
+    """The dense-layer factory every matmul site shares: ``nn.Dense`` for
+    ``collectives: xla`` (byte-identical to every pre-ISSUE-12 program),
+    :class:`OverlapDense` for ``overlapped``."""
+    if cfg.collectives == "overlapped":
+        return OverlapDense(
+            features, shard_axis=shard_axis, tp_logical=tp_logical,
+            name=name, dtype=cdtype, param_dtype=pdtype,
+        )
+    return nn.Dense(features, name=name, dtype=cdtype, param_dtype=pdtype)
+
+
 class CausalSelfAttention(nn.Module):
     cfg: ModelConfig
 
@@ -63,13 +131,17 @@ class CausalSelfAttention(nn.Module):
         cdtype = _dtype(cfg.compute_dtype)
         pdtype = _dtype(cfg.param_dtype)
 
-        def dense(name):
+        def dense(name, shard_axis=0):
             # LoRA injection point (dtc_tpu/adapters/): with an active
             # adapter config and a targeted name, the base Dense output
             # gains a low-rank delta from the SEPARATE "lora" collection;
             # at rank 0 apply_lora is an identity passthrough that creates
             # no variables — the rank-0 graph is bitwise the base graph.
-            layer = nn.Dense(cfg.d_model, name=name, dtype=cdtype, param_dtype=pdtype)
+            # ``shard_axis`` is the kernel axis FSDP shards (0 = the
+            # d_model contraction for q/k/v, 1 = the d_model output for
+            # out_proj) — consumed only by the overlapped-collectives
+            # flavor (_dense, ISSUE 12).
+            layer = _dense(cfg, cfg.d_model, name, shard_axis, cdtype, pdtype)
             return lambda h: apply_lora(
                 self, layer, h, cfg=cfg, name=name, train=train
             )
@@ -229,7 +301,7 @@ class CausalSelfAttention(nn.Module):
                 )
         with jax.named_scope("attn_proj"):
             out = out.reshape(b, t, cfg.d_model)
-            out = dense("out_proj")(out)
+            out = dense("out_proj", shard_axis=1)(out)
             # Row-parallel output: constraining back to embed-replicated
             # makes XLA insert the TP all-reduce here.
             out = nn.with_logical_constraint(out, ("batch", "seq", "embed"))
@@ -248,11 +320,14 @@ class MLP(nn.Module):
         cdtype = _dtype(cfg.compute_dtype)
         pdtype = _dtype(cfg.param_dtype)
         with jax.named_scope("mlp"):
-            fc1 = nn.Dense(cfg.d_ff, name="fc1", dtype=cdtype, param_dtype=pdtype)
+            # FSDP shards fc1's d_model CONTRACTION axis and fc2's d_model
+            # OUTPUT axis — the shard_axis the overlapped-collectives
+            # flavor of _dense keys its ring schedule on (ISSUE 12).
+            fc1 = _dense(cfg, cfg.d_ff, "fc1", 0, cdtype, pdtype, "mlp")
             h = apply_lora(self, fc1, x, cfg=cfg, name="fc1", train=self.train)
             h = nn.gelu(h)
             h = nn.with_logical_constraint(h, ("batch", "seq", "mlp"))  # column-parallel
-            fc2 = nn.Dense(cfg.d_model, name="fc2", dtype=cdtype, param_dtype=pdtype)
+            fc2 = _dense(cfg, cfg.d_model, "fc2", 1, cdtype, pdtype, "mlp")
             h = apply_lora(self, fc2, h, cfg=cfg, name="fc2", train=self.train)
             h = nn.with_logical_constraint(h, ("batch", "seq", "embed"))  # row-parallel all-reduce
         return h

@@ -51,32 +51,13 @@ NEG_INF = -1e9
 
 
 def _ambient_mesh():
-    """The mesh to hand the inner shard_map.
+    """The mesh to hand the inner shard_map — the shared
+    ``parallel.sharding.ambient_mesh`` (abstract mesh under a jit trace so
+    ring attention nests inside the pipeline's manual region; physical
+    mesh from the trainer's ``with mesh:`` context otherwise)."""
+    from dtc_tpu.parallel.sharding import ambient_mesh
 
-    Under a jit with an active trace context this is the ABSTRACT mesh —
-    which carries per-axis Manual/Auto state, so ring attention nests
-    correctly inside another manual region (the pipeline's shard_map over
-    "pipe": the abstract mesh there is Manual on pipe, Auto elsewhere, and
-    shard_map requires the passed mesh to match it exactly). Falls back to
-    the physical mesh installed by the trainer's ``with mesh:`` context.
-    """
-    try:
-        from jax.sharding import get_abstract_mesh
-    except ImportError:  # jax 0.4.x keeps it private
-        from jax._src.mesh import get_abstract_mesh
-
-    amesh = get_abstract_mesh()
-    if amesh is not None and not amesh.empty:
-        return amesh
-    from jax._src.mesh import thread_resources
-
-    mesh = thread_resources.env.physical_mesh
-    if mesh.empty:
-        raise RuntimeError(
-            "ring attention needs an active mesh context (`with mesh:`); "
-            "none is installed"
-        )
-    return mesh
+    return ambient_mesh()
 
 
 def _block(qc, kc, vc, scale, diag: bool):

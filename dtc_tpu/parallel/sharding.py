@@ -96,6 +96,72 @@ def ring_rules_from(
 RING_RULES: tuple[tuple[str, str | None], ...] = ring_rules_from(DEFAULT_RULES)
 
 
+def ambient_mesh(allow_empty: bool = False):
+    """The mesh in scope for an op entering a nested ``shard_map``.
+
+    Under a jit trace this is the ABSTRACT mesh — which carries per-axis
+    Manual/Auto state, so a partial-manual region nests correctly inside
+    another manual computation (e.g. the pipeline's shard_map over
+    "pipe") — falling back to the physical mesh installed by the
+    trainer's ``with mesh:`` context. One definition shared by ring
+    attention and the overlapped-collectives ops (ISSUE 12), so every
+    nested-manual op resolves its mesh identically."""
+    try:
+        from jax.sharding import get_abstract_mesh
+    except ImportError:  # jax 0.4.x keeps it private
+        from jax._src.mesh import get_abstract_mesh
+
+    amesh = get_abstract_mesh()
+    # jax 0.4.x returns a bare tuple outside any trace context — only a
+    # real (non-empty) AbstractMesh is usable here.
+    if amesh is not None and getattr(amesh, "empty", True) is False:
+        return amesh
+    from jax._src.mesh import thread_resources
+
+    mesh = thread_resources.env.physical_mesh
+    if mesh.empty:
+        if allow_empty:
+            return None
+        raise RuntimeError(
+            "this op needs an active mesh context (`with mesh:`); "
+            "none is installed"
+        )
+    return mesh
+
+
+def fsdp_axis_in_scope() -> str | None:
+    """The mesh axis FSDP shards parameter storage over, visible from
+    inside model code — or None when FSDP is not in effect.
+
+    Reads the ACTIVE flax logical-axis rules (the trainer's
+    ``nn.logical_axis_rules(rules)`` context): the "embed_p" logical axis
+    maps to a mesh axis exactly when FSDP_RULES (or a derivation like
+    ``ring_rules_from(FSDP_RULES)``) is installed, and that axis must be
+    non-trivial on the ambient mesh. This is how the overlapped
+    collectives (ops/overlap_collectives.py, ISSUE 12) find the ring: the
+    rule table stays the single source of parallelism truth — no new
+    config plumbing into the model."""
+    from flax import linen as nn
+
+    rules = dict(nn.get_logical_axis_rules())
+    axis = rules.get("embed_p")
+    if not isinstance(axis, str):
+        return None
+    mesh = ambient_mesh(allow_empty=True)
+    if mesh is None:
+        return None
+    sizes = dict(zip(mesh.axis_names, (int(s) for s in mesh.shape.values())))
+    seq = rules.get("seq")
+    if isinstance(seq, str) and sizes.get(seq, 1) > 1:
+        # Sequence-parallel rules (ring/ulysses derivations): activations
+        # are seq-sharded between layers, which the overlap ring's
+        # batch×full-seq region layout would silently re-gather. Defer to
+        # SP — the serialized path runs; overlap+SP composition is future
+        # work (README "Overlapped collectives").
+        return None
+    return axis if sizes.get(axis, 1) > 1 else None
+
+
 def logical_to_spec(axes: Sequence[str | None], rules: Sequence[tuple[str, str | None]]) -> P:
     """Map a tuple of logical axis names to a PartitionSpec under ``rules``."""
     table = dict(rules)

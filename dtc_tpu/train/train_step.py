@@ -89,6 +89,52 @@ def canonicalize_state_placement(state: TrainState, mesh: Mesh) -> TrainState:
     return jax.tree.map(leaf, state)
 
 
+def resolve_collectives(train_cfg, model_cfg, mesh: Mesh | None = None):
+    """Route ``TrainConfig.collectives`` onto the model config (the dense
+    layers are where the ring schedules live — ops/overlap_collectives.py,
+    ISSUE 12), with the mode's validity checked HERE so every train-step
+    consumer (trainer, bench, audit lowering) applies one rule:
+
+    - ``overlapped`` + pipeline parallelism is rejected: the ring's
+      shard_map over the FSDP axis cannot nest under the pipeline's
+      manual region the way its collectives would need (same restriction
+      as ring attention), and FSDP rules never combine with pipe > 1 in
+      this repo anyway.
+    - otherwise the model config comes back with ``collectives`` set; for
+      rules that do not shard "embed_p" the mode is inert by design
+      (OverlapDense falls back to the serialized dot per call).
+
+    Either config may request the mode: the effective value is
+    "overlapped" when EITHER TrainConfig or ModelConfig says so —
+    ModelConfig.collectives is a public validated knob, and a train-level
+    default of "xla" must not silently revert it.
+    """
+    import dataclasses
+
+    train_mode = getattr(train_cfg, "collectives", "xla")
+    mode = (
+        "overlapped"
+        if "overlapped" in (train_mode, model_cfg.collectives)
+        else "xla"
+    )
+    pipe = (
+        mesh.shape.get("pipe", 1) if mesh is not None
+        else max(train_cfg.mesh.pipe, 1) * train_cfg.mesh.dcn_pipe
+    )
+    # The pipeline rejection must fire for EVERY route into the mode —
+    # including a model-config-only request that needs no replace below.
+    if mode == "overlapped" and (train_cfg.parallel == "pp" or pipe > 1):
+        raise ValueError(
+            "collectives: overlapped is not supported under pipeline "
+            "parallelism (the FSDP ring's shard_map cannot nest inside "
+            "the pipeline's manual region); use a mesh with pipe == 1 — "
+            "overlapped composes with DP/FSDP/TP"
+        )
+    if mode == model_cfg.collectives:
+        return model_cfg
+    return dataclasses.replace(model_cfg, collectives=mode)
+
+
 @struct.dataclass
 class Batch:
     """Input/target token batch (same shape contract as the reference's

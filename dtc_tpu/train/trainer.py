@@ -47,6 +47,7 @@ from dtc_tpu.train.train_step import (
     canonicalize_state_placement,
     create_train_step,
     normalize_spec,
+    resolve_collectives,
 )
 from dtc_tpu.obs import Telemetry
 from dtc_tpu.utils.dist import is_lead_process, maybe_initialize_distributed
@@ -338,6 +339,16 @@ def _train(
             f"devices={num_devices} processes={jax.process_count()}"
         )
 
+    # Overlapped training collectives (ISSUE 12): the TrainConfig knob is
+    # lifted onto the model config, because the ring schedules live at
+    # the dense-matmul sites (models/gpt.py OverlapDense). Validity (no
+    # pipeline) is resolve_collectives' one rule; inertness is surfaced
+    # below (inside the mesh+rules contexts, via the SAME
+    # fsdp_axis_in_scope resolution the matmul sites use — rule table,
+    # axis size, and the sequence-parallel deferral all covered) so a
+    # knob that will change nothing never passes silently.
+    model_cfg = resolve_collectives(train_cfg, model_cfg, mesh)
+
     model = GPT(model_cfg)
     # LoRA finetune mode (dtc_tpu/adapters/): the TrainState is the
     # adapter subtree, the base is a frozen step input. One flag here —
@@ -379,6 +390,18 @@ def _train(
         )
 
     with mesh, nn.logical_axis_rules(rules):
+        if model_cfg.collectives == "overlapped" and lead:
+            from dtc_tpu.parallel.sharding import fsdp_axis_in_scope
+
+            if fsdp_axis_in_scope() is None:
+                print(
+                    "[dtc_tpu] WARNING: collectives: overlapped is inert "
+                    "on this run — no usable FSDP ring in scope (the "
+                    "active rules don't shard 'embed_p', its mesh axis "
+                    "is size 1, or sequence-parallel rules own the "
+                    "activations); every matmul keeps the serialized "
+                    "XLA path"
+                )
         base_params = None
         if lora_on:
             state, base_params = init_adapter_state(

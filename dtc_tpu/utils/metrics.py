@@ -243,6 +243,26 @@ def decode_roofline_ms(
     return total / (hbm_gbps * 1e9) * 1e3
 
 
+def tp_sharded_param_count(cfg: ModelConfig) -> int:
+    """Parameters Megatron TP actually shards over "model": the block
+    matmul kernels, their COLUMN-parallel biases (qkv/fc1 — out_proj/fc2
+    biases live on the replicated ``embed_p`` output axis), and the
+    vocab-parallel lm_head. LayerNorms, row-parallel biases, and the
+    wte/wpe embeddings are TP-replicated. Mirrors the DEFAULT_RULES /
+    FSDP_RULES tables (tests pin it against ``param_specs``); the MoE
+    expert tensors shard over "model" via the ``experts_p`` rows and are
+    counted whole (router replicated)."""
+    d, f, L = cfg.d_model, cfg.d_ff, cfg.n_layers
+    v = cfg.padded_vocab_size
+    if cfg.moe_experts > 0:
+        e = cfg.moe_experts
+        ffn = e * (d * f + f + f * d + d)      # wi/bi/wo/bo (experts_p)
+    else:
+        ffn = d * f + f + f * d                # fc1 kernel+bias, fc2 kernel
+    per_block = 4 * d * d + 3 * d + ffn        # q/k/v/out kernels, qkv biases
+    return L * per_block + d * v + v           # + lm_head kernel+bias
+
+
 def comm_bytes_per_step(
     cfg: ModelConfig,
     batch: int,
@@ -269,6 +289,20 @@ def comm_bytes_per_step(
       ``(stages-1)`` cuts crossed forward and backward by every
       microbatch.
 
+    Combined DP×FSDP×TP meshes (``parallel == "fsdp"`` with ``model > 1``
+    — configs/train_config_3d.yaml, ISSUE 12): the naive
+    ``n_params / model`` per-device share over-divides, because TP only
+    shards the matmul family (qkv/out/fc1/fc2 kernels + their
+    column-parallel biases, lm_head) while LayerNorms, row-parallel
+    biases, and the embeddings stay TP-replicated — and FSDP gathers /
+    reduce-scatters each device's ACTUAL share. The 3d term therefore
+    splits the tree: ``n_tp_sharded / model + n_tp_replicated``. Plain DP
+    keeps the historical formula (committed audit baselines pin it).
+    The estimate is transport-independent on purpose: the overlapped
+    ring (ops/overlap_collectives.py) re-phases exactly these wire bytes
+    under compute, it does not change them — which is what lets the
+    census cross-check hold for both ``collectives:`` modes.
+
     Returns per-collective estimates plus their ``total``; all terms are
     0.0 for axes of size 1, so the dict is safe to emit unconditionally.
     """
@@ -282,8 +316,15 @@ def comm_bytes_per_step(
     dp = 0.0
     if d_axis > 1:
         factor = 3.0 if parallel == "fsdp" else 2.0
-        # Per-device parameter share: TP/PP already split the tree.
-        local_params = n_params / (m_axis * p_axis)
+        if parallel == "fsdp" and m_axis > 1:
+            # DP×FSDP×TP: per-device share = TP-sharded params / model +
+            # the TP-replicated remainder (each TP rank stores and
+            # gathers its own full copy of those).
+            n_tp = tp_sharded_param_count(cfg)
+            local_params = (n_tp / m_axis + (n_params - n_tp)) / p_axis
+        else:
+            # Per-device parameter share: TP/PP already split the tree.
+            local_params = n_params / (m_axis * p_axis)
         dp = factor * (d_axis - 1) / d_axis * local_params * pbytes
 
     tp = 0.0

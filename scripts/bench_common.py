@@ -22,9 +22,17 @@ if REPO not in sys.path:
 from bench import flagship_model_cfg  # noqa: E402  (re-export for scripts)
 
 
-def build_step(batch=32, grad_clip=1.0, weight_decay=0.1, **model_knobs):
+def build_step(batch=32, grad_clip=1.0, weight_decay=0.1, parallel="dp",
+               collectives="xla", **model_knobs):
     """Returns (step_fn, state, batch_obj, key, (mesh, rules), model_cfg)
-    for the flagship GPT-89.6M train step with the given knobs."""
+    for the flagship GPT-89.6M train step with the given knobs.
+
+    ``parallel="fsdp"`` + ``collectives`` drive the ISSUE 12 overlap A/B
+    rows: FSDP_RULES activate and the model config carries the
+    collectives mode (resolve_collectives — the same lift the trainer
+    does), so the benched step is the trainer's step."""
+    import dataclasses
+
     import jax
     import jax.numpy as jnp
     from flax import linen as nn
@@ -33,20 +41,24 @@ def build_step(batch=32, grad_clip=1.0, weight_decay=0.1, **model_knobs):
     from dtc_tpu.data.synthetic import synthetic_batch_iterator
     from dtc_tpu.models.gpt import GPT
     from dtc_tpu.parallel.mesh import mesh_from_config
-    from dtc_tpu.parallel.sharding import DEFAULT_RULES
+    from dtc_tpu.parallel.sharding import DEFAULT_RULES, FSDP_RULES
     from dtc_tpu.train.train_step import Batch, create_train_step
     from dtc_tpu.train.trainer import init_state
 
     model_cfg = flagship_model_cfg(**model_knobs)
+    if collectives != "xla":
+        model_cfg = dataclasses.replace(model_cfg, collectives=collectives)
     opt_cfg = OptimConfig(lr=3e-4, weight_decay=weight_decay, grad_clip=grad_clip)
     train_cfg = TrainConfig(
-        seed=0, parallel="dp", batch=batch, steps=1, log_every=1, output_dir="",
-        dataset="synthetic", warmup_steps=0, prefetch=0, mesh=MeshConfig(),
+        seed=0, parallel=parallel, batch=batch, steps=1, log_every=1,
+        output_dir="", dataset="synthetic", warmup_steps=0, prefetch=0,
+        mesh=MeshConfig(),
     )
-    mesh = mesh_from_config("dp", train_cfg.mesh)
+    rules = FSDP_RULES if parallel == "fsdp" else DEFAULT_RULES
+    mesh = mesh_from_config(parallel, train_cfg.mesh)
     model = GPT(model_cfg)
-    with mesh, nn.logical_axis_rules(DEFAULT_RULES):
-        state = init_state(model, model_cfg, train_cfg, opt_cfg, mesh, DEFAULT_RULES)
+    with mesh, nn.logical_axis_rules(rules):
+        state = init_state(model, model_cfg, train_cfg, opt_cfg, mesh, rules)
         # state= pins out_shardings so the step compiles ONCE (see
         # train_step.state_shardings — without it GSPMD layout churn pays
         # a second identical cold compile on the call after warmup step 1).
@@ -54,7 +66,7 @@ def build_step(batch=32, grad_clip=1.0, weight_decay=0.1, **model_knobs):
     tok = next(synthetic_batch_iterator(batch, model_cfg.max_seq_len + 1, model_cfg.vocab_size))
     batch_obj = Batch(x=jnp.asarray(tok[:, :-1]), y=jnp.asarray(tok[:, 1:]))
     key = jax.random.key(0, impl="rbg")
-    return step_fn, state, batch_obj, key, (mesh, DEFAULT_RULES), model_cfg
+    return step_fn, state, batch_obj, key, (mesh, rules), model_cfg
 
 
 def time_step(steps=20, warmup=6, trace_dir=None, trace_steps=6, **knobs) -> float:

@@ -15,8 +15,8 @@ timeout -k 10 120 env JAX_PLATFORMS=cpu python -m pytest --collect-only -q -p no
   tests/test_generate.py tests/test_decode_fused.py tests/test_metrics.py \
   tests/test_analysis.py \
   tests/test_serve.py tests/test_trace.py tests/test_devprof.py \
-  tests/test_adapters.py > /dev/null || {
-    echo "tier-1 pre-gate: MoE/HLO/decode/analysis/serve/trace/devprof/adapters test collection failed" >&2; exit 1; }
+  tests/test_adapters.py tests/test_overlap_collectives.py > /dev/null || {
+    echo "tier-1 pre-gate: MoE/HLO/decode/analysis/serve/trace/devprof/adapters/overlap test collection failed" >&2; exit 1; }
 # Pre-gate 2 (ISSUE 5 + 6): the graph audit — lower/compile the
 # dp/tp/fsdp/ep train steps (8-virtual-device CPU mesh), the greedy decode
 # scan, AND the serving (continuous-batching) decode step; run the rule
@@ -34,9 +34,14 @@ timeout -k 10 120 env JAX_PLATFORMS=cpu python -m pytest --collect-only -q -p no
 # (ISSUE 11 grew the entry set to 9: --decode now also audits the
 # layer-fused megakernel flavor `decode_fused_layers`, and --serve the
 # int8-cache `serve_decode_int8` flavor — timeout raised 480 -> 660 for
-# the two extra lower+compile+execute passes on this 1-core host.)
-timeout -k 10 660 env JAX_PLATFORMS=cpu python scripts/audit_graph.py \
-  --modes dp,tp,fsdp,ep --decode --serve --check-baselines || {
+# the two extra lower+compile+execute passes on this 1-core host.
+# ISSUE 12 grows it to 11: `fsdp_overlapped` and `3d` (DP×FSDP×TP) audit
+# the overlapped-collectives ring programs — their census requires the
+# ring transport (collective-permute / Pallas custom-calls) and forbids
+# the serialized per-layer kernel all-gathers; timeout 660 -> 960 for
+# the two extra unrolled-ring compiles.)
+timeout -k 10 960 env JAX_PLATFORMS=cpu python scripts/audit_graph.py \
+  --modes dp,tp,fsdp,ep,fsdp_overlapped,3d --decode --serve --check-baselines || {
     echo "tier-1 pre-gate: graph audit failed (see findings above)" >&2; exit 1; }
 # Pre-gate 3 (ISSUE 6): fast scheduler smoke — four requests (two sharing
 # a system-prompt prefix) through the real continuous-batching engine on

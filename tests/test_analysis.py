@@ -314,7 +314,8 @@ def test_baseline_missing_and_env_mismatch(tmp_path):
 # --------------------------------------------------------------------------
 
 @pytest.mark.slow
-@pytest.mark.parametrize("mode", ["dp", "tp", "fsdp", "ep"])
+@pytest.mark.parametrize("mode", ["dp", "tp", "fsdp", "ep",
+                                  "fsdp_overlapped", "3d"])
 def test_green_path_matches_committed_baseline(mode):
     """The acceptance run, per mode: lower/compile the real step, audit
     clean, fingerprint equal to the committed baseline. `slow`: each mode

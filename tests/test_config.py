@@ -40,8 +40,11 @@ def test_repo_configs_load():
     train_cfg, model_cfg, opt_cfg = load_config("configs/train_config_dp.yaml")
     assert model_cfg.d_model == 512 and model_cfg.n_layers == 12
     assert opt_cfg.lr == pytest.approx(3e-4)
+    # The 3d example is DP×FSDP×TP with overlapped collectives (ISSUE 12;
+    # the PP example lives in train_config_pp.yaml).
     t3, _, _ = load_config("configs/train_config_3d.yaml")
-    assert t3.mesh.pipe == 2
+    assert t3.parallel == "fsdp" and t3.collectives == "overlapped"
+    assert (t3.mesh.data, t3.mesh.model) == (4, 2)
     # Long-context example: sweep-tuned asymmetric fwd/bwd flash tilings.
     _, mlc, _ = load_config(
         "configs/train_config_longctx.yaml",
