@@ -746,6 +746,186 @@ def serve_lora_rows(
     )
 
 
+def fleet_bench(
+    rps: float | None,
+    *,
+    model_cfg=None,
+    model_label: str = "flagship",
+    n_replicas: int = 3,
+    n_requests: int = 48,
+    slots: int = 2,
+    prompt_len: int = 16,
+    max_new_tokens: int = 16,
+    seed: int = 0,
+    queue_depth: int | None = None,
+    shed_watermark: float = 0.75,
+    kill_replica_at: int = 0,
+    max_wall_s: float = 600.0,
+) -> dict:
+    """One serving-FLEET row (ISSUE 13): Poisson arrivals at ``rps``
+    offered requests/s through the tenant-aware router over
+    ``n_replicas`` in-process engine replicas, measuring the fleet SLO
+    surface — sustained tokens/s, fleet-level p50/p99 TTFT + ms/token
+    (the router's pooled histograms), AND the per-replica percentile
+    rows (each replica's own registry) the fleet view is reduced from.
+
+    ``kill_replica_at > 0`` is the chaos leg: replica 0 is declared dead
+    at that router iteration mid-traffic, its queued + in-flight
+    requests fail over to survivors (prompt+generated re-prefill), and
+    the row records failovers/replica_deaths plus ``zero_silent_drops``
+    — accepted submits reconciled against terminal results, the fleet
+    acceptance criterion.
+
+    Honesty: in-process replicas time-slice ONE host's compute, so CPU
+    fleet wall-clocks are SHAPE-only (scheduling/failover/accounting are
+    real; absolute throughput is not — compare fleet rows only against
+    fleet rows with the same replica count, which the drift guard
+    enforces)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from dtc_tpu.config.schema import ChaosConfig, RouterConfig, ServeConfig
+    from dtc_tpu.models.gpt import GPT
+    from dtc_tpu.serve import FleetRouter, QueueFullError, Request, RequestState
+
+    model_cfg = model_cfg or flagship_model_cfg(dropout=0.0)
+    model = GPT(model_cfg)
+    params = model.init(
+        {"params": jax.random.PRNGKey(0)}, jnp.ones((1, 1), jnp.int32),
+        train=False,
+    )["params"]
+    rcfg = RouterConfig(
+        n_replicas=n_replicas,
+        serve=ServeConfig(
+            slots=slots,
+            page_size=16,
+            queue_depth=queue_depth or 4 * slots,
+            max_new_tokens=max_new_tokens,
+            prefill_bucket=prompt_len,
+            shed_watermark=shed_watermark,
+        ),
+        chaos=ChaosConfig(
+            enabled=kill_replica_at > 0,
+            fleet_kill_replica_at_step=kill_replica_at,
+            fleet_target_replica=0,
+        ),
+    )
+    router = FleetRouter(model, params, rcfg)
+    rng = np.random.RandomState(seed)
+    arrivals = (
+        np.zeros(n_requests)
+        if rps is None
+        else np.cumsum(rng.exponential(1.0 / rps, size=n_requests))
+    )
+    prompts = [
+        rng.randint(0, model_cfg.vocab_size, size=prompt_len).tolist()
+        for _ in range(n_requests)
+    ]
+    router.warmup(prompts[0])
+
+    rejected = 0
+    accepted = 0
+    i = 0
+    t0 = time.perf_counter()
+    while True:
+        now = time.perf_counter() - t0
+        while i < n_requests and arrivals[i] <= now:
+            try:
+                router.submit(Request(
+                    rid=f"q{i}", prompt=prompts[i],
+                    max_new_tokens=max_new_tokens,
+                ))
+                accepted += 1
+            except QueueFullError:
+                rejected += 1  # typed fleet backpressure — counted
+            i += 1
+        busy = router.step()
+        if now > max_wall_s:
+            break
+        if not busy:
+            if i >= n_requests:
+                break
+            time.sleep(max(0.0, min(
+                arrivals[i] - (time.perf_counter() - t0), 0.01)))
+    wall = time.perf_counter() - t0
+
+    res = list(router.results.values())
+    done = [r for r in res if r.state is RequestState.DONE]
+    by_state = lambda s: sum(1 for r in res if r.state.value == s)  # noqa: E731
+    summ = router.fleet_summary()
+    row = {
+        "rps": None if rps is None else round(rps, 3),
+        "n_requests": n_requests,
+        "n_replicas": n_replicas,
+        "slots": slots,
+        "prompt_len": prompt_len,
+        "max_new_tokens": max_new_tokens,
+        "seed": seed,
+        "kill_replica_at": kill_replica_at,
+        "completed": len(done),
+        "shed": by_state("shed"),
+        "expired": by_state("expired"),
+        "failed": by_state("failed"),
+        "rejected": rejected,
+        "failovers": summ["failovers"],
+        "replica_deaths": summ["replica_deaths"],
+        # Zero-silent-drops reconciliation: every ACCEPTED submit must
+        # reach a terminal fleet result (the acceptance criterion — a
+        # False here is a bug, not a bench observation).
+        "zero_silent_drops": accepted == len(res),
+        "wall_s": round(wall, 3),
+        "sustained_tokens_per_sec": (
+            round(sum(len(r.tokens) for r in done) / wall, 1) if wall else None
+        ),
+        "ttft_p50_s": summ["ttft_p50_s"],
+        "ttft_p99_s": summ["ttft_p99_s"],
+        "ms_per_token": summ["ms_per_token_p50"],
+        "ms_per_token_p99": summ["ms_per_token_p99"],
+        "per_replica": {
+            k: {kk: v[kk] for kk in (
+                "state", "done", "ttft_p99_s", "ms_per_token_p99")}
+            for k, v in summ["replicas"].items()
+        },
+        "platform": jax.devices()[0].platform,
+        "serve_model": model_label,
+        "decode_attention": model_cfg.decode_attention,
+        "kv_cache_dtype": model_cfg.kv_cache_dtype,
+    }
+    router.close()
+    return row
+
+
+def serve_fleet_rows(
+    emit, model_cfg=None, *, seed: int = 0, n_replicas: int = 3, **kw
+) -> None:
+    """The fleet row set (ISSUE 13): closed-loop calibration over
+    ``n_replicas`` replicas, open-loop Poisson at 0.9x and 3x the
+    calibrated fleet request capacity (same rationale as
+    serve_bench_rows: 3x is decisively past saturation — the row that
+    shows FLEET backpressure holding typed), and the replica-kill chaos
+    leg at 0.9x — failover mid-traffic with zero silent drops, per-
+    replica AND fleet percentiles recorded."""
+    n_req = kw.get("n_requests", 48)
+    cal = emit("serve_fleet_cal_closed_loop", _safe(
+        "serve_fleet_cal_closed_loop",
+        lambda: fleet_bench(
+            None, model_cfg=model_cfg, seed=seed, n_replicas=n_replicas,
+            queue_depth=n_req, shed_watermark=0.0, **kw)))
+    cap_tps = cal.get("sustained_tokens_per_sec")
+    if not cap_tps:
+        print("# fleet bench: calibration failed; skipping load rows")
+        return
+    cap_rps = cap_tps / cal["max_new_tokens"]
+    for suffix, frac, kill in (
+        ("load90", 0.9, 0), ("sat300", 3.0, 0), ("kill", 0.9, 8),
+    ):
+        label = f"serve_fleet_{suffix}"
+        emit(label, _safe(label, lambda f=frac, k=kill: fleet_bench(
+            cap_rps * f, model_cfg=model_cfg, seed=seed,
+            n_replicas=n_replicas, kill_replica_at=k, **kw)))
+
+
 def _bench_detail(path: str) -> dict:
     """Parsed ``# bench-detail:`` dict of one committed BENCH file, or {}.
 
@@ -863,10 +1043,17 @@ def decode_drift_guard(extra: dict, repo_dir: str | None = None) -> list[str]:
         return (r.get("decode_attention", "fused"), r.get("kv_cache_dtype", "auto"))
 
     compare("decode", "ms_per_token", lambda o, r: decode_cfg(o) == decode_cfg(r))
+    # Fleet rows (serve_fleet_*, ISSUE 13) ride the serve family via the
+    # shared "serve" prefix; their extra same-config requirement is the
+    # replica count (absent on both sides for single-engine rows) — a
+    # 3-replica row must never be judged against a 1-replica one, and
+    # the chaos kill leg only against kill legs (kill_replica_at match).
     compare("serve", "ms_per_token", lambda o, r: (
         decode_cfg(o) == decode_cfg(r)
         and o.get("platform") == r.get("platform")
         and o.get("serve_model") == r.get("serve_model")
+        and o.get("n_replicas") == r.get("n_replicas")
+        and o.get("kill_replica_at") == r.get("kill_replica_at")
     ))
     compare("fsdp_overlap", "step_time_s", lambda o, r: all(
         o.get(k) == r.get(k) for k in ("collectives", "platform", "devices")
@@ -1033,6 +1220,9 @@ def main(argv: list[str] | None = None) -> None:
         serve_bench_rows(emit, seed=args.serve_seed, **serve_cfg_kw)
         serve_lora_rows(emit, seed=args.serve_seed, **serve_cfg_kw)
         serve_int8_row(emit, serve_cfg_kw, seed=args.serve_seed)
+        # Fleet rows (ISSUE 13): router over 3 in-process replicas —
+        # calibration, 0.9x/3x offered load, replica-kill chaos leg.
+        serve_fleet_rows(emit, seed=args.serve_seed, **serve_cfg_kw)
         emit("trace_overhead", _safe("trace_overhead", trace_overhead_bench))
         extra = {
             "devices": jax.device_count(),
@@ -1147,6 +1337,10 @@ def main(argv: list[str] | None = None) -> None:
     # Multi-tenant LoRA rows (ISSUE 10): N tenants on one resident base;
     # the delta vs the serve_* rows is the per-token multi-tenant price.
     serve_lora_rows(emit, seed=args.serve_seed, **serve_cfg_kw)
+    # Fleet rows (ISSUE 13): tenant-aware router over 3 in-process
+    # replicas — calibration, 0.9x/3x offered load, and the replica-kill
+    # chaos leg (failover mid-traffic, zero silent drops).
+    serve_fleet_rows(emit, seed=args.serve_seed, **serve_cfg_kw)
     # Tracing substrate cost (ISSUE 7): host-side span-emission µs per
     # step, A/B traced vs untraced — PERF.md reads the % off this row.
     emit("trace_overhead", _safe("trace_overhead", trace_overhead_bench))

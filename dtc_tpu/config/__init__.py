@@ -2,11 +2,13 @@ from dtc_tpu.config.schema import (
     MeshConfig,
     ModelConfig,
     OptimConfig,
+    RouterConfig,
     ServeConfig,
     TrainConfig,
 )
 from dtc_tpu.config.loader import (
     load_config,
+    load_router_config,
     load_serve_config,
     load_yaml_dataclass,
 )
@@ -15,9 +17,11 @@ __all__ = [
     "MeshConfig",
     "ModelConfig",
     "OptimConfig",
+    "RouterConfig",
     "ServeConfig",
     "TrainConfig",
     "load_config",
+    "load_router_config",
     "load_serve_config",
     "load_yaml_dataclass",
 ]

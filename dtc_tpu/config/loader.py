@@ -87,6 +87,35 @@ def load_serve_config(
     return serve_cfg, model_cfg
 
 
+def load_router_config(
+    router_config_path: str | Path,
+    model_config_path: str | Path | None = None,
+    router_overrides: dict[str, Any] | None = None,
+    model_overrides: dict[str, Any] | None = None,
+):
+    """Load the (router, model) config pair for the serving fleet
+    (``dtc_tpu/serve/router.py``).
+
+    Same sibling-``model_config.yaml`` convention as
+    :func:`load_serve_config`; the per-replica engine config nests under
+    the router YAML's ``serve:`` block (see
+    ``configs/router_config.yaml``).
+    """
+    from dtc_tpu.config.schema import ModelConfig, RouterConfig
+
+    router_config_path = Path(router_config_path)
+    model_config_path = Path(
+        model_config_path or router_config_path.parent / "model_config.yaml"
+    )
+    router_cfg = load_yaml_dataclass(
+        router_config_path, RouterConfig, overrides=router_overrides
+    )
+    model_cfg = load_yaml_dataclass(
+        model_config_path, ModelConfig, overrides=model_overrides
+    )
+    return router_cfg, model_cfg
+
+
 def load_finetune_config(
     finetune_config_path: str | Path,
     model_config_path: str | Path | None = None,

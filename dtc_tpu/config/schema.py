@@ -557,12 +557,27 @@ class ChaosConfig:
     serve_corrupt_page_at_step: int = 0  # damage a completed KV page of the oldest active request
     serve_stall_at_step: int = 0         # sleep stall_s inside the scheduler loop
     serve_poison_logits_at_step: int = 0  # the decode step's logits read back NaN
+    # --- fleet faults (dtc_tpu/serve/router.py, iteration numbers are
+    # 1-based ROUTER iterations; fleet_target_replica picks the victim).
+    # Kill drives cross-replica failover (survivor re-prefill, token-
+    # identical, zero silent drops), the stall drives the replica-level
+    # hung-step watchdog + degraded routing, the partition drives
+    # retry-with-backoff / missed-heartbeat / dead-escalation.
+    fleet_kill_replica_at_step: int = 0   # declare the target replica dead mid-traffic
+    fleet_stall_replica_at_step: int = 0  # stall the target replica's step by stall_s
+    fleet_partition_at_step: int = 0      # target replica unreachable for N iterations
+    fleet_partition_iters: int = 2        # partition length (router iterations)
+    fleet_target_replica: int = 0         # victim replica index for fleet faults
 
     def __post_init__(self) -> None:
         if self.corrupt_mode not in ("truncate", "flip"):
             raise ValueError(f"unknown corrupt_mode {self.corrupt_mode!r}")
         if self.stall_s < 0:
             raise ValueError("stall_s must be >= 0")
+        if self.fleet_partition_iters < 1:
+            raise ValueError("fleet_partition_iters must be >= 1")
+        if self.fleet_target_replica < 0:
+            raise ValueError("fleet_target_replica must be >= 0")
 
 
 @dataclass(frozen=True)
@@ -713,6 +728,91 @@ class ServeConfig:
                 "would otherwise never be detected and the damaged request "
                 "would complete with wrong tokens (use 1 for the bit-exact "
                 "no-tainted-tokens guarantee)"
+            )
+
+
+@dataclass(frozen=True)
+class RouterConfig:
+    """Fleet-router configuration (``dtc_tpu/serve/router.py``): a
+    tenant-aware front-end over ``n_replicas`` serving engines with
+    cache-affinity placement, fleet backpressure, health-state routing,
+    and chaos-verified failover. See README "Serving fleet" and
+    ``configs/router_config.yaml`` for knob semantics.
+    """
+
+    #: Engine replicas behind the router (in-process handles today; the
+    #: same abstraction a multi-host transport plugs into).
+    n_replicas: int = 2
+    # Placement policy: "affinity" = tenant adapter residency first, then
+    # shared-prefix residency, then least-loaded (degraded / about-to-
+    # shed replicas deprioritized); "least_loaded" skips the affinity
+    # preferences; "round_robin" is the A/B control.
+    placement: str = "affinity"
+    # Consecutive missed heartbeats (an unreachable replica that answered
+    # neither step nor submit) before the router declares it dead and
+    # fails its requests over. Short partitions heal below this.
+    heartbeat_miss_limit: int = 3
+    # Iterations without a fresh bad-health signal (hung-step flag / SLO
+    # degrade) before a DEGRADED replica is routed to again.
+    degraded_hold_iters: int = 16
+    # Per-request failover budget: hops (cross-replica resubmissions)
+    # beyond this end the request typed (RequestFailedError) instead of
+    # ping-ponging across a dying fleet forever.
+    failover_max_hops: int = 3
+    # Step budget for drain() per replica (router-initiated or SIGTERM);
+    # requests unfinished past it are typed-evicted (EngineClosedError).
+    drain_max_steps: int = 512
+    # Per-replica engine config (each replica runs its own scheduler,
+    # queue, pool, SLO monitor, and — if configured — serve-level chaos).
+    serve: ServeConfig = field(default_factory=ServeConfig)
+    # Transient replica faults (ReplicaUnreachableError) retry with this
+    # backoff discipline (resilience.retry.retry_call) before the router
+    # routes around the replica.
+    retry: StreamRetryConfig = field(default_factory=lambda: StreamRetryConfig(
+        max_attempts=3, backoff_s=0.02, backoff_max_s=0.5, jitter=0.0,
+        max_elapsed_s=5.0,
+    ))
+    # Replica-level hung-step watchdog (flagging layer over whole replica
+    # step durations — catches stalls that land outside the engine's
+    # timed iteration, e.g. a wedged transport). Deliberately LESS
+    # twitchy than the engine's in-loop default (factor 16 vs 8, more
+    # samples): replica iterations legitimately mix ~ms decode steps
+    # with prefill-heavy admissions, and a flag here carries routing
+    # consequences (DEGRADED deprioritizes the replica) — measured under
+    # closed-loop saturation, factor 8 flagged every healthy replica.
+    watchdog: WatchdogConfig = field(
+        default_factory=lambda: WatchdogConfig(
+            enabled=True, factor=16.0, min_samples=8,
+        )
+    )
+    # Fleet-level chaos (fleet_kill_replica / fleet_stall_replica /
+    # fleet_partition — see ChaosConfig). Serve-level chaos goes on
+    # serve.chaos and fires once PER REPLICA.
+    chaos: ChaosConfig = field(default_factory=ChaosConfig)
+
+    def __post_init__(self) -> None:
+        if self.n_replicas < 1:
+            raise ValueError("n_replicas must be >= 1")
+        if self.placement not in ("affinity", "least_loaded", "round_robin"):
+            raise ValueError(
+                f"unknown placement {self.placement!r}; expected 'affinity', "
+                "'least_loaded' or 'round_robin'"
+            )
+        if self.heartbeat_miss_limit < 1:
+            raise ValueError("heartbeat_miss_limit must be >= 1")
+        if self.degraded_hold_iters < 1:
+            raise ValueError("degraded_hold_iters must be >= 1")
+        if self.failover_max_hops < 0:
+            raise ValueError("failover_max_hops must be >= 0")
+        if self.drain_max_steps < 1:
+            raise ValueError("drain_max_steps must be >= 1")
+        if (
+            self.chaos.enabled
+            and self.chaos.fleet_target_replica >= self.n_replicas
+        ):
+            raise ValueError(
+                f"chaos.fleet_target_replica {self.chaos.fleet_target_replica} "
+                f"outside the fleet (n_replicas={self.n_replicas})"
             )
 
 

@@ -59,14 +59,31 @@ def _step_times(events: list[dict[str, Any]]) -> dict[int, float]:
 _SERVE_ETYPES = ("serve_request", "serve_admit", "serve_evict",
                  "serve_reject", "serve_corruption")
 
+#: Per-shard SLO fields copied onto the per-host rows of the reduced
+#: view (the fleet's per-replica p50/p99 table — ISSUE 13).
+_SERVE_HOST_KEYS = ("ttft_p50_s", "ttft_p99_s", "ms_per_token_p50",
+                    "ms_per_token_p99", "tokens_per_sec", "failover_hops")
+
 
 def _serve_stats(events: list[dict[str, Any]]) -> dict[str, Any] | None:
-    """Per-shard serving reduction: terminal request counts by state and
-    the highest scheduler iteration observed. ``None`` when the shard
-    holds no serving events at all."""
+    """Per-shard serving reduction: terminal request counts by state, the
+    highest scheduler iteration observed, and — the fleet leg (ISSUE 13)
+    — p50/p99 TTFT + ms/token and a tokens/s estimate derived from the
+    ``serve_request`` terminals themselves, so a router deployment's
+    per-replica shards reduce to exactly the per-replica SLO rows the
+    fleet view needs (a replica that absorbed a failover shows it in its
+    own p99). ``None`` when the shard holds no serving events at all."""
+    from dtc_tpu.utils.percentile import nearest_rank, round_opt as r4
+
     iterations = 0
     requests = 0
     by_state: dict[str, int] = {}
+    ttft: list[float] = []
+    mspt: list[float] = []
+    tokens_done = 0
+    hops = 0
+    ts_lo: float | None = None
+    ts_hi: float | None = None
     seen = False
     for e in events:
         et = e.get("etype")
@@ -76,14 +93,44 @@ def _serve_stats(events: list[dict[str, Any]]) -> dict[str, Any] | None:
         it = e.get("iteration")
         if isinstance(it, (int, float)):
             iterations = max(iterations, int(it))
+        ts = e.get("ts")
+        if isinstance(ts, (int, float)):
+            ts_lo = ts if ts_lo is None else min(ts_lo, ts)
+            ts_hi = ts if ts_hi is None else max(ts_hi, ts)
         if et == "serve_request":
             requests += 1
             state = str(e.get("state", "?"))
             by_state[state] = by_state.get(state, 0) + 1
+            if isinstance(e.get("ttft_s"), (int, float)):
+                ttft.append(float(e["ttft_s"]))
+            if isinstance(e.get("ms_per_token"), (int, float)):
+                mspt.append(float(e["ms_per_token"]))
+            if state == "done" and isinstance(e.get("n_tokens"), int):
+                tokens_done += e["n_tokens"]
+            if isinstance(e.get("n_hops"), int):
+                hops += e["n_hops"]
     if not seen:
         return None
-    return {"requests": requests, "iterations": iterations,
-            "by_state": by_state}
+    out: dict[str, Any] = {
+        "requests": requests, "iterations": iterations,
+        "by_state": by_state,
+    }
+    if ttft:
+        out["ttft_p50_s"] = r4(nearest_rank(ttft, 0.50))
+        out["ttft_p99_s"] = r4(nearest_rank(ttft, 0.99))
+    if mspt:
+        out["ms_per_token_p50"] = r4(nearest_rank(mspt, 0.50))
+        out["ms_per_token_p99"] = r4(nearest_rank(mspt, 0.99))
+    if hops:
+        out["failover_hops"] = hops
+    wall = (ts_hi - ts_lo) if ts_lo is not None else 0.0
+    if tokens_done and wall > 0:
+        out["tokens_per_sec"] = round(tokens_done / wall, 2)
+    out["_ttft"] = ttft    # cross-shard merge inputs (stripped below)
+    out["_mspt"] = mspt
+    out["_tokens_done"] = tokens_done
+    out["_ts"] = (ts_lo, ts_hi)
+    return out
 
 
 def reduce_shards(
@@ -130,25 +177,59 @@ def reduce_shards(
             serve_host[proc] = serve
     serve_total = None
     if serve_host:
+        from dtc_tpu.utils.percentile import nearest_rank, round_opt as r4
+
         by_state: dict[str, int] = {}
+        all_ttft: list[float] = []
+        all_mspt: list[float] = []
+        tokens_done = 0
+        ts_lo: float | None = None
+        ts_hi: float | None = None
         for s in serve_host.values():
             for k, v in s["by_state"].items():
                 by_state[k] = by_state.get(k, 0) + v
+            all_ttft.extend(s.pop("_ttft"))
+            all_mspt.extend(s.pop("_mspt"))
+            tokens_done += s.pop("_tokens_done")
+            lo, hi = s.pop("_ts")
+            if lo is not None:
+                ts_lo = lo if ts_lo is None else min(ts_lo, lo)
+                ts_hi = hi if ts_hi is None else max(ts_hi, hi)
         serve_total = {
             "requests": sum(s["requests"] for s in serve_host.values()),
             "iterations": max(s["iterations"] for s in serve_host.values()),
             "by_state": by_state,
         }
+        # Fleet-level SLO surface: percentiles over the POOLED terminals
+        # (not a mean of per-replica percentiles — that would hide the
+        # failover tail inside the averaging) + a tokens/s estimate over
+        # the fleet's event-time span.
+        if all_ttft:
+            serve_total["ttft_p50_s"] = r4(nearest_rank(all_ttft, 0.50))
+            serve_total["ttft_p99_s"] = r4(nearest_rank(all_ttft, 0.99))
+        if all_mspt:
+            serve_total["ms_per_token_p50"] = r4(nearest_rank(all_mspt, 0.50))
+            serve_total["ms_per_token_p99"] = r4(nearest_rank(all_mspt, 0.99))
+        wall = (ts_hi - ts_lo) if ts_lo is not None else 0.0
+        if tokens_done and wall > 0:
+            serve_total["tokens_per_sec"] = round(tokens_done / wall, 2)
+        hop_total = sum(s.get("failover_hops", 0) for s in serve_host.values())
+        if hop_total:
+            serve_total["failover_hops"] = hop_total
     if not per_host:
         if serve_total is None:
             return None
         # Serving-only run: the explicit "no training steps, K serve
-        # iterations" summary (ISSUE 7 satellite).
+        # iterations" summary (ISSUE 7 satellite). Per-host rows carry
+        # the per-replica SLO percentiles (ISSUE 13 — a fleet's replica
+        # shards ARE its per-replica p99 table; the failover shows up in
+        # the absorbing replica's row).
         hosts = {
             str(proc): {
                 "steps": 0,
                 "serve_requests": s["requests"],
                 "straggler": False,
+                **{k: s[k] for k in _SERVE_HOST_KEYS if k in s},
             }
             for proc, s in serve_host.items()
         }
@@ -182,12 +263,14 @@ def reduce_shards(
             "max_step_time_s": round(max(times.values()), 6),
             "straggler": lagging,
         }
-    # Mixed fleet: serving-only hosts still appear in the table.
+    # Mixed fleet: serving-only hosts still appear in the table, with
+    # their per-replica SLO percentiles (ISSUE 13).
     for proc, s in serve_host.items():
         entry = hosts.setdefault(
             str(proc), {"steps": 0, "straggler": False}
         )
         entry["serve_requests"] = s["requests"]
+        entry.update({k: s[k] for k in _SERVE_HOST_KEYS if k in s})
     means = list(host_means.values())
     out = {
         "hosts": hosts,

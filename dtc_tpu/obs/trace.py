@@ -232,7 +232,12 @@ def _event_time(e: dict[str, Any]) -> float | None:
 _INSTANT_ETYPES = frozenset({
     "chaos", "anomaly", "recovery", "hung_step", "slo_breach",
     "slo_recovered", "recompile", "serve_admit", "serve_evict",
-    "serve_reject", "serve_corruption", "serve_request",
+    "serve_reject", "serve_corruption", "serve_request", "serve_shutdown",
+    # Fleet-router events (ISSUE 13): failover/route marks land on the
+    # owning rid's track; replica state changes on their own track.
+    "router_route", "router_failover", "router_replica_state",
+    "router_reject", "router_heartbeat_missed", "router_adapter_load",
+    "router_drained",
 })
 
 

@@ -20,6 +20,14 @@ corruption drive the evict→re-prefill recovery path, the scheduler stall
 drives the serving hung-step watchdog, and poisoned logits drive the
 finite-check + retry-from-pre-step-cache path — all asserted
 token-for-token identical to an uninjected run in tests/test_serve.py.
+
+The fleet router (``dtc_tpu/serve/router.py``) consults the ``fleet_*``
+hooks the same way at ITS boundaries: replica kill drives cross-replica
+failover (re-prefill on survivors, token-identical, zero silent drops),
+the replica stall drives the replica-level hung-step watchdog + degraded
+routing, and the partition drives retry-with-backoff, missed-heartbeat
+accounting, and the dead-replica escalation — tests/test_router.py and
+scripts/fleet_smoke.py.
 """
 
 from __future__ import annotations
@@ -152,6 +160,52 @@ class ChaosInjector:
             0 < self.cfg.serve_poison_logits_at_step <= it
             and self._fire("serve_poison_logits", iteration=it)
         )
+
+    # ---- fleet plane (dtc_tpu/serve/router.py — iteration numbers are
+    # 1-based ROUTER iterations; the router consults these at its own
+    # boundaries so every fault lands on the production routing paths) --
+    def fleet_kill_replica(self, it: int) -> bool:
+        """Kill one replica mid-traffic: the router declares
+        ``fleet_target_replica`` dead and fails its queued AND in-flight
+        requests over to survivors (re-submitting prompt+generated-so-far
+        through the re-prefill path — completed requests must come out
+        token-identical, the rest typed; zero silent drops). Deferred-fire
+        contract like :meth:`serve_preempt`: the router consults only
+        while traffic is in flight."""
+        return (
+            0 < self.cfg.fleet_kill_replica_at_step <= it
+            and self._fire(
+                "fleet_kill_replica", iteration=it,
+                replica=self.cfg.fleet_target_replica,
+            )
+        )
+
+    def fleet_stall_replica(self, it: int) -> float:
+        """Seconds ``fleet_target_replica``'s next step must stall (0 =
+        no fault). The stall lands OUTSIDE the engine's timed iteration —
+        a wedged transport, not a slow kernel — so the REPLICA-level
+        hung-step watchdog must flag it and the router's health machine
+        mark the replica degraded (routed around, not killed)."""
+        if 0 < self.cfg.fleet_stall_replica_at_step <= it and self._fire(
+            "fleet_stall_replica", iteration=it,
+            replica=self.cfg.fleet_target_replica, stall_s=self.cfg.stall_s,
+        ):
+            return self.cfg.stall_s
+        return 0.0
+
+    def fleet_partition(self, it: int) -> int:
+        """Network partition: ``fleet_target_replica`` is unreachable for
+        the returned number of router iterations (0 = no fault). Short
+        partitions heal (retry-with-backoff + missed-heartbeat
+        accounting); one outliving ``heartbeat_miss_limit`` escalates to
+        the kill/failover path."""
+        if 0 < self.cfg.fleet_partition_at_step <= it and self._fire(
+            "fleet_partition", iteration=it,
+            replica=self.cfg.fleet_target_replica,
+            iters=self.cfg.fleet_partition_iters,
+        ):
+            return self.cfg.fleet_partition_iters
+        return 0
 
     def maybe_corrupt_checkpoint(self, step: int, step_dir: str) -> bool:
         """After the checkpoint at ``step`` was fully written (manifest

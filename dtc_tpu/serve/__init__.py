@@ -6,7 +6,14 @@ layer above the fused decode kernel of PR 4).
 - :mod:`~dtc_tpu.serve.paged_cache` — page-pool accounting over the
   packed KV cache, prefix-store pins, integrity-checksum units;
 - :mod:`~dtc_tpu.serve.request` — request state machine, typed failure
-  taxonomy (rejection/shed/deadline/eviction are typed, never silent).
+  taxonomy (rejection/shed/deadline/eviction are typed, never silent);
+- :mod:`~dtc_tpu.serve.replica` — one fleet member: an engine behind a
+  replica handle with heartbeat, hung-step health, and the
+  healthy→degraded→draining→dead state machine;
+- :mod:`~dtc_tpu.serve.router` — tenant-aware front-end router over N
+  replicas: adapter-residency/prefix cache-affinity placement, fleet
+  backpressure, and chaos-verified failover (a dead replica's queued and
+  in-flight requests re-prefill on survivors, zero silent drops).
 
 Robustness is the load-bearing design input: overload sheds by policy,
 deadlines cancel mid-decode, cache exhaustion / preemption / detected
@@ -17,10 +24,14 @@ bit-exact in tier-1 CPU tests. See README "Serving runtime".
 
 from dtc_tpu.serve.engine import ServingEngine, init_slot_cache
 from dtc_tpu.serve.paged_cache import PageAllocator, pages_for
+from dtc_tpu.serve.replica import EngineReplica, ReplicaState
 from dtc_tpu.serve.request import (
     AdapterStoreFullError,
     DeadlineExceededError,
+    EngineClosedError,
+    FleetSaturatedError,
     QueueFullError,
+    ReplicaUnreachableError,
     Request,
     RequestFailedError,
     RequestState,
@@ -31,16 +42,24 @@ from dtc_tpu.serve.request import (
     TransientStepError,
     UnknownAdapterError,
 )
+from dtc_tpu.serve.router import FleetRecord, FleetRouter
 
 __all__ = [
     "AdapterStoreFullError",
     "DeadlineExceededError",
+    "EngineClosedError",
+    "EngineReplica",
+    "FleetRecord",
+    "FleetRouter",
+    "FleetSaturatedError",
     "PageAllocator",
     "QueueFullError",
+    "ReplicaUnreachableError",
     "Request",
     "RequestFailedError",
     "RequestState",
     "RequestTooLargeError",
+    "ReplicaState",
     "ServeError",
     "ServeResult",
     "ServingEngine",

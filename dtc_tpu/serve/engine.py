@@ -79,6 +79,7 @@ from dtc_tpu.serve.paged_cache import PageAllocator, kv_token_bytes, pages_for
 from dtc_tpu.serve.request import (
     TERMINAL_STATES,
     DeadlineExceededError,
+    EngineClosedError,
     QueueFullError,
     Request,
     RequestFailedError,
@@ -245,6 +246,8 @@ class ServingEngine:
         self.slots = [_Slot() for _ in range(cfg.slots)]
         self.last_tok = np.zeros((cfg.slots,), np.int32)
 
+        self.closed = False  # shutdown()/drain: submit() refuses typed
+        self._in_shutdown = False  # one flight dump for the whole drain
         self.queue: list[Request] = []
         self.requests: dict[str, Request] = {}
         self.results: dict[str, ServeResult] = {}
@@ -300,7 +303,24 @@ class ServingEngine:
     # jitted device functions (each compiles ONCE; every per-request
     # quantity — slot, frontier, valid length — is a traced argument)
     # ------------------------------------------------------------------
+    #: (model, page_size) -> the jitted fn set. Flax modules hash by
+    #: structure, so N in-process replicas serving the SAME model (the
+    #: fleet router's configuration) share ONE set of executables instead
+    #: of compiling step/prefill/insert once per replica — the honest
+    #: reading of "in-process replicas share host compute". The fns close
+    #: over nothing engine-specific (params/cache/config all arrive as
+    #: arguments), so sharing cannot couple replica state.
+    _FN_CACHE: dict = {}
+
     def _build_fns(self) -> None:
+        cache_key = (self.model, self.cfg.page_size)
+        cached = ServingEngine._FN_CACHE.get(cache_key)
+        if cached is not None:
+            (self._step_fn, self._prefill_fn, self._insert_fn,
+             self._fingerprint_fn, self._corrupt_fn, adapter_insert) = cached
+            if adapter_insert is not None:
+                self._adapter_insert_fn = adapter_insert
+            return
         model = self.model
         lora_on = self.lora_on
 
@@ -453,11 +473,15 @@ class ServingEngine:
         self._insert_fn = insert_fn
         self._fingerprint_fn = fingerprint_fn
         self._corrupt_fn = corrupt_fn
+        ServingEngine._FN_CACHE[cache_key] = (
+            step_fn, prefill_fn, insert_fn, fingerprint_fn, corrupt_fn,
+            getattr(self, "_adapter_insert_fn", None),
+        )
 
     # ------------------------------------------------------------------
     # submission (admission control)
     # ------------------------------------------------------------------
-    def submit(self, req: Request) -> str:
+    def submit(self, req: Request, *, resume: ServeResult | None = None) -> str:
         """Enqueue one request. Typed backpressure — raises
         :class:`QueueFullError` past ``queue_depth`` and
         :class:`RequestTooLargeError` for requests that could never run;
@@ -465,7 +489,30 @@ class ServingEngine:
         after its previous submission reached a terminal state (the new
         result then replaces the old one) — resubmitting an in-flight rid
         is a caller bug that would silently merge two requests into one
-        record, so it raises ``ValueError`` like the Request validators."""
+        record, so it raises ``ValueError`` like the Request validators.
+
+        ``resume`` is the cross-replica failover path (the router's PR 6
+        re-prefill lifted fleet-wide): a prior partial :class:`ServeResult`
+        whose ``tokens`` are prompt-continuation generated elsewhere. The
+        new record starts with those tokens, so admission re-prefills
+        prompt+generated and greedy decode continues token-for-token
+        identically. Timing accounting is the load-bearing part:
+        ``submitted_t`` / ``first_token_t`` carry over (TTFT stays
+        anchored at the ORIGINAL submit — fleet histograms must include
+        failover cost, not hide it), ``requeued_t`` restarts the
+        ``req.queued`` span at THIS hop, and ``n_hops`` increments."""
+        if self.closed:
+            self.reg.counter("serve_rejected").inc()
+            self.reg.emit("serve_reject", rid=req.rid, reason="closed")
+            raise EngineClosedError(
+                f"request {req.rid}: engine is shut down / draining"
+            )
+        if resume is not None and len(resume.tokens) >= req.max_new_tokens:
+            raise ValueError(
+                f"request {req.rid}: resume carries {len(resume.tokens)} "
+                f"tokens >= max_new_tokens {req.max_new_tokens} — the prior "
+                "hop should have completed it (caller bug)"
+            )
         if req.rid in self.requests:  # present == not yet terminal
             raise ValueError(
                 f"request {req.rid}: rid already in flight "
@@ -514,15 +561,56 @@ class ServingEngine:
             # eviction→re-prefill recovery path depends on this).
             self.adapter_store.acquire(req.adapter)
         self.requests[req.rid] = req
-        self.results[req.rid] = ServeResult(
+        res = ServeResult(
             rid=req.rid, state=RequestState.QUEUED, tokens=[],
             submitted_t=now, adapter=req.adapter,
         )
+        if resume is not None:
+            res.tokens = list(resume.tokens)
+            if resume.submitted_t is not None:
+                res.submitted_t = resume.submitted_t
+            res.first_token_t = resume.first_token_t
+            res.n_evictions = resume.n_evictions
+            res.n_retries = resume.n_retries
+            res.n_hops = resume.n_hops + 1
+            res.degraded = resume.degraded
+            res.requeued_t = now  # this hop's req.queued span starts here
+        self.results[req.rid] = res
         ttl = self.cfg.deadline_s if req.deadline_s is None else req.deadline_s
-        self._deadline[req.rid] = now + ttl if ttl and ttl > 0 else float("inf")
+        # Deadlines anchor at the ORIGINAL submit (== now for a fresh
+        # request): a failover hop must not grant a request a fresh TTL.
+        self._deadline[req.rid] = (
+            res.submitted_t + ttl if ttl and ttl > 0 else float("inf")
+        )
         self.queue.append(req)
         self.reg.counter("serve_submitted").inc()
         return req.rid
+
+    # -- load/occupancy introspection (the router's placement inputs) ----
+    @property
+    def queue_room(self) -> int:
+        """Admissions ``submit()`` would still accept before typed
+        QueueFullError backpressure — the fleet router's per-replica
+        admission-coordination signal (it routes around a full replica
+        instead of overriding its bound)."""
+        return max(0, self.cfg.queue_depth - len(self.queue))
+
+    @property
+    def active_count(self) -> int:
+        """Slots currently decoding."""
+        return sum(1 for s in self.slots if s.rid is not None)
+
+    @property
+    def load(self) -> int:
+        """Queued + in-flight requests (the least-loaded placement key)."""
+        return len(self.queue) + self.active_count
+
+    @property
+    def over_shed_watermark(self) -> bool:
+        """Queue occupancy past the shed watermark — the replica is about
+        to shed; the router prefers peers with headroom."""
+        wm = self.cfg.shed_watermark
+        return wm > 0 and len(self.queue) > int(wm * self.cfg.queue_depth)
 
     def drain_results(self) -> dict[str, ServeResult]:
         """Remove and return every TERMINAL result — the long-running
@@ -662,6 +750,68 @@ class ServingEngine:
                 break
         return self.results
 
+    def shutdown(
+        self, *, mode: str = "drain", max_steps: int = 512,
+        reason: str = "shutdown",
+    ) -> dict[str, ServeResult]:
+        """Graceful stop — the serving side of the trainer's SIGTERM
+        contract (PR 2/7): stop admitting (``submit()`` raises a typed
+        :class:`EngineClosedError` from here on), then
+
+        - ``mode="drain"``: keep stepping until every queued/in-flight
+          request is terminal or ``max_steps`` runs out; anything still
+          unfinished at the budget is typed-evicted (FAILED +
+          EngineClosedError — partial tokens preserved on the result);
+        - ``mode="evict"``: typed-evict immediately (the hard-deadline
+          SIGTERM path — e.g. a preemption notice too short to drain).
+
+        Either way the recovery bus is drained (pending chaos/recovery
+        records land in the stream), the flight recorder dumps ONCE with
+        the shutdown reason — previously serving only dumped on crash
+        paths — and sinks are flushed. Idempotent; returns ``results``.
+        """
+        if mode not in ("drain", "evict"):
+            raise ValueError(f"unknown shutdown mode {mode!r}")
+        if self.closed:
+            return self.results
+        self.closed = True
+        self._in_shutdown = True  # per-request FAILED dumps collapse into
+        try:                      # the single shutdown dump below
+            if mode == "drain":
+                for _ in range(max_steps):
+                    if not self.step():
+                        break
+            for req in list(self.queue):
+                self.queue.remove(req)
+                self._finish(
+                    req.rid, RequestState.FAILED,
+                    EngineClosedError(
+                        f"request {req.rid}: engine shut down while queued "
+                        f"({reason})"
+                    ),
+                )
+            for slot in self.slots:
+                if slot.rid is None:
+                    continue
+                rid = slot.rid
+                self._release_slot(rid)
+                self._finish(
+                    rid, RequestState.FAILED,
+                    EngineClosedError(
+                        f"request {rid}: engine shut down mid-decode "
+                        f"({reason}; partial tokens preserved)"
+                    ),
+                )
+        finally:
+            self._in_shutdown = False
+        self._drain_bus()
+        self.reg.emit(
+            "serve_shutdown", reason=reason, mode=mode, iteration=self._it,
+        )
+        self.dump_flight(f"shutdown: {reason}", iteration=self._it)
+        self.reg.flush()
+        return self.results
+
     # ------------------------------------------------------------------
     # boundary phases
     # ------------------------------------------------------------------
@@ -760,20 +910,34 @@ class ServingEngine:
             self._evict(victim, reason="admission_pressure")
         return True
 
+    @staticmethod
+    def prefix_key(req: Request) -> tuple | None:
+        """The shared-prefix store key this request would hit (None when
+        it declares no usable prefix). ONE definition — the engine's
+        store lookups and the router's prefix-affinity placement must
+        agree on it or affinity silently routes to misses. Keys are
+        scoped PER ADAPTER: the same token prefix under two tenants
+        yields different KV bytes (the adapter reshapes the k/v
+        projections), so each (adapter, tokens) pair is its own entry."""
+        plen = min(req.shared_prefix_len, len(req.prompt) - 1)
+        if plen <= 0:
+            return None
+        return (req.adapter,) + tuple(int(t) for t in req.prompt[:plen])
+
+    def has_prefix(self, req: Request) -> bool:
+        """Whether this engine's prefix store already holds the request's
+        shared prefix (the router's cache-affinity signal)."""
+        key = self.prefix_key(req)
+        return key is not None and key in self._prefix_store
+
     def _prefix_base(self, req: Request) -> tuple[PyTree, int]:
         """(base cache, base length) for this request's prefill: the
         shared-prefix store entry when one matches (prefilled once,
-        reused by every admission), else a fresh batch-1 cache.
-
-        Prefix keys are scoped PER ADAPTER: the same token prefix under
-        two tenants yields different KV bytes (the adapter reshapes the
-        k/v projections), so each (adapter, tokens) pair holds its own
-        store entry — per-tenant system prompts still share across that
-        tenant's requests."""
-        plen = min(req.shared_prefix_len, len(req.prompt) - 1)
-        if plen <= 0:
+        reused by every admission), else a fresh batch-1 cache."""
+        key = self.prefix_key(req)
+        if key is None:
             return init_cache(self.model, 1), 0
-        key = (req.adapter,) + tuple(int(t) for t in req.prompt[:plen])
+        plen = len(key) - 1  # key = (adapter, *prefix tokens)
         if key in self._prefix_store:
             self.alloc.touch_prefix(key)
             self.reg.counter("serve_prefix_hits").inc()
@@ -884,9 +1048,13 @@ class ServingEngine:
             )
             # A breaching latency SLO degrades new admissions exactly like
             # crossing the queue watermark — the scheduler reacting to the
-            # online monitor instead of a post-hoc bench row.
+            # online monitor instead of a post-hoc bench row. A resumed
+            # (failover) request that was ALREADY degraded stays capped:
+            # a hop must never un-shrink a promise made to shed load.
             slo_hot = self.slo is not None and self.slo.degrade_active
-            if self.cfg.degrade_max_new_tokens > 0 and (over_queue or slo_hot):
+            if self.cfg.degrade_max_new_tokens > 0 and (
+                over_queue or slo_hot or res.degraded
+            ):
                 eff = min(eff, self.cfg.degrade_max_new_tokens)
                 if eff < req.max_new_tokens:
                     res.degraded = True
@@ -1258,7 +1426,7 @@ class ServingEngine:
             rid=rid, error=type(error).__name__ if error else None,
         )
         self.reg.emit("serve_request", iteration=self._it, **res.summary())
-        if state is RequestState.FAILED:
+        if state is RequestState.FAILED and not self._in_shutdown:
             self.dump_flight(f"request_failed: {rid}", rid=rid)
 
     def _on_retry_event(self, etype: str, **fields: Any) -> None:

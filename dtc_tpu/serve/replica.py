@@ -1,0 +1,191 @@
+"""One fleet member: a :class:`ServingEngine` behind a replica handle.
+
+The handle is the seam a multi-host transport plugs into later: the
+router only ever talks to ``submit()`` / ``step()`` / the health and
+load introspection here, never to the engine's internals — so swapping
+the in-process engine for an RPC stub changes this file, not the router.
+What the in-process version models faithfully:
+
+- **heartbeat** — a successful ``step()`` IS the beat (it resets the
+  ``missed_beats`` counter); a partitioned replica (chaos
+  ``fleet_partition``, or a real network fault in the multi-host
+  picture) raises
+  :class:`~dtc_tpu.serve.request.ReplicaUnreachableError` instead, and
+  the router counts the miss toward the death verdict
+  (``heartbeat_miss_limit``);
+- **hung-step health** — the replica reuses the existing
+  :class:`~dtc_tpu.resilience.watchdog.StepWatchdog` (flagging layer)
+  over its OWN step durations, one level above the engine's in-loop
+  watchdog: an injected fleet stall (or a genuinely wedged replica)
+  flags here even when the engine never got to run, and the flag is a
+  DEGRADED signal to the router's state machine;
+- **state machine** — ``healthy → degraded → draining → dead``:
+  degraded replicas keep serving but stop attracting new placements
+  (and recover after a clean hold window); draining replicas finish
+  their in-flight work then retire; dead replicas are failover sources,
+  never targets.
+
+Honesty note: in-process replicas share one host's compute — N replicas
+time-slice the same cores, so fleet wall-clocks are SHAPE-only on CPU
+(scheduling, failover, accounting are real; absolute throughput is not).
+"""
+
+from __future__ import annotations
+
+import enum
+import time
+from typing import Any, Callable
+
+from dtc_tpu.resilience.watchdog import StepWatchdog
+from dtc_tpu.serve.engine import ServingEngine
+from dtc_tpu.serve.request import (
+    ReplicaUnreachableError,
+    Request,
+    ServeResult,
+)
+
+
+class ReplicaState(enum.Enum):
+    HEALTHY = "healthy"
+    DEGRADED = "degraded"    # serving, but routed around for new work
+    DRAINING = "draining"    # finishing in-flight; admits nothing new
+    DEAD = "dead"            # failover source; never stepped again
+
+
+class EngineReplica:
+    """See module docstring. ``replica_id`` doubles as the obs process
+    index: the replica's registry/shard/Perfetto track all carry it, so
+    per-replica fleet observability falls out of the existing multi-host
+    machinery (PR 7's shard merge) with no new plumbing."""
+
+    def __init__(
+        self,
+        replica_id: int,
+        engine: ServingEngine,
+        *,
+        watchdog_cfg: Any = None,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self.replica_id = replica_id
+        self.engine = engine
+        self.clock = clock
+        self.state = ReplicaState.HEALTHY
+        self.missed_beats = 0
+        self.dead_reason: str | None = None
+        # Replica-level hung-step flagging over whole step() durations —
+        # the fleet stall lands OUTSIDE the engine's timed iteration (a
+        # transport stall would too), so the engine's own watchdog cannot
+        # see it; this one can.
+        self.watchdog = (
+            StepWatchdog(watchdog_cfg, clock=clock)
+            if watchdog_cfg is not None and watchdog_cfg.enabled else None
+        )
+        self.hung_flags = 0
+        self._stall_s = 0.0        # chaos: next step sleeps this long
+        self._partition_left = 0   # chaos: steps of unreachability left
+
+    # -- chaos / transport-fault injection points ------------------------
+    def stall(self, seconds: float) -> None:
+        self._stall_s = max(self._stall_s, float(seconds))
+
+    def partition(self, iters: int) -> None:
+        self._partition_left = max(self._partition_left, int(iters))
+
+    @property
+    def partitioned(self) -> bool:
+        return self._partition_left > 0
+
+    # -- load / residency introspection (placement inputs) ---------------
+    @property
+    def accepting(self) -> bool:
+        """May receive NEW placements. Degraded replicas still accept
+        (they are serving — only deprioritized); draining/dead never."""
+        return self.state in (ReplicaState.HEALTHY, ReplicaState.DEGRADED)
+
+    @property
+    def queue_room(self) -> int:
+        return self.engine.queue_room
+
+    @property
+    def load(self) -> int:
+        return self.engine.load
+
+    def resident_adapters(self) -> frozenset[str]:
+        store = self.engine.adapter_store
+        return frozenset(store.snapshot()["resident"]) if store else frozenset()
+
+    def has_prefix(self, req: Request) -> bool:
+        return self.engine.has_prefix(req)
+
+    # -- the transport surface -------------------------------------------
+    def submit(self, req: Request, *, resume: ServeResult | None = None) -> str:
+        if self.state is ReplicaState.DEAD:
+            raise ReplicaUnreachableError(
+                f"replica {self.replica_id} is dead ({self.dead_reason})"
+            )
+        if self.partitioned:
+            raise ReplicaUnreachableError(
+                f"replica {self.replica_id} unreachable (partition, "
+                f"{self._partition_left} step(s) left)"
+            )
+        return self.engine.submit(req, resume=resume)
+
+    def step(self) -> bool:
+        """One scheduler iteration on this replica. Raises
+        :class:`ReplicaUnreachableError` while partitioned (the router
+        counts the missed beat); otherwise stamps the heartbeat and feeds
+        the replica-level watchdog. Returns the engine's busy flag."""
+        if self.state is ReplicaState.DEAD:
+            return False
+        if self.partitioned:
+            self._partition_left -= 1
+            raise ReplicaUnreachableError(
+                f"replica {self.replica_id} missed heartbeat (partition)"
+            )
+        t0 = self.clock()
+        stalled = self._stall_s > 0
+        if stalled:
+            # The injected fleet stall: burns real (injectable) clock time
+            # OUTSIDE the engine iteration, like a wedged transport would.
+            self.engine.sleep(self._stall_s)
+            self._stall_s = 0.0
+        busy = self.engine.step()
+        dur = self.clock() - t0
+        self.missed_beats = 0  # a completed step IS the heartbeat
+        # Same discipline as the engine's in-loop watchdog: only WORKING
+        # iterations feed the trailing median (idle polling spins are
+        # microsecond-scale and would flag every healthy step) — but a
+        # stalled step is always observed, idle or not: the stall is the
+        # outlier this watchdog exists to flag.
+        if self.watchdog is not None and (self.engine._worked or stalled):
+            flag = self.watchdog.observe(self.engine._it, dur)
+            if flag is not None:
+                self.hung_flags += 1
+                self.engine.reg.emit(
+                    "hung_step", runtime="fleet",
+                    replica=self.replica_id, **flag,
+                )
+        return busy
+
+    def miss_beat(self) -> int:
+        """Router-side accounting for a step that never answered."""
+        self.missed_beats += 1
+        return self.missed_beats
+
+    # -- lifecycle --------------------------------------------------------
+    def mark(self, state: ReplicaState, *, reason: str = "") -> None:
+        if state is ReplicaState.DEAD:
+            self.dead_reason = reason or "killed"
+        self.state = state
+
+    def drain(self, *, max_steps: int = 512) -> dict[str, ServeResult]:
+        """Router-initiated graceful retirement: the engine's shutdown
+        contract (finish or typed-evict, bus drained, flight dumped),
+        then DEAD with reason "drained"."""
+        self.mark(ReplicaState.DRAINING)
+        out = self.engine.shutdown(
+            mode="drain", max_steps=max_steps,
+            reason=f"replica {self.replica_id} drain",
+        )
+        self.mark(ReplicaState.DEAD, reason="drained")
+        return out

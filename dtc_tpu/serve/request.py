@@ -94,7 +94,35 @@ class UnknownAdapterError(ServeError):
     """The request names an adapter that is not resident in the engine's
     adapter store (or the model was built without adapter support).
     Raised at ``submit()`` — load the adapter first
-    (``ServingEngine.load_adapter``)."""
+    (``ServingEngine.load_adapter``). At the fleet level the router
+    converts this into re-load-or-reroute (it holds registered factor
+    trees); only when no replica holds the factors AND none were
+    registered does the request end typed with this as the cause —
+    NEVER silently served on slot-0 base weights."""
+
+
+class EngineClosedError(ServeError):
+    """The engine is shut down (``ServingEngine.shutdown()``) or draining:
+    new submissions are refused, and any request still unfinished when the
+    drain budget runs out ends typed with this error — the graceful-stop
+    contract (stop admitting, finish or typed-evict, drain the recovery
+    bus, dump the flight recorder) the trainer has had since PR 2."""
+
+
+class FleetSaturatedError(QueueFullError):
+    """Fleet-level backpressure: every live replica's admission queue is
+    at depth (or the replica that holds a required resource is full).
+    A ``QueueFullError`` subclass so single-engine callers' typed-429
+    handling works unchanged against the router."""
+
+
+class ReplicaUnreachableError(ServeError):
+    """A replica did not answer (network partition / dead process in the
+    multi-host picture; the chaos ``fleet_partition`` kind in-process).
+    Transient from the router's point of view: retried with backoff via
+    ``resilience.retry.retry_call``, then routed around; a replica that
+    stays unreachable past the heartbeat-miss budget is declared dead and
+    its requests fail over to survivors."""
 
 
 class AdapterStoreFullError(ServeError):
@@ -163,8 +191,15 @@ class ServeResult:
     n_retries: int = 0
     degraded: bool = False               # max_new_tokens shrunk at admission
     adapter: str | None = None           # tenant adapter (None = base)
+    # Cross-replica failover hops (router resubmissions of prompt +
+    # generated-so-far onto a survivor). 0 for a request that never left
+    # its first replica; in-replica evictions count in n_evictions.
+    n_hops: int = 0
     # Eviction re-queue time: the next req.queued trace span starts here
     # instead of at submit (cleared on re-admission; never in summary()).
+    # Set per HOP too — a failover resubmission restarts the queued span
+    # at the hop, while ttft_s stays anchored at the ORIGINAL submit, so
+    # fleet TTFT histograms include (never under-report) failover cost.
     requeued_t: float | None = None
 
     @property
@@ -203,6 +238,7 @@ class ServeResult:
             "ms_per_token": r3(self.ms_per_token),
             "n_evictions": self.n_evictions,
             "n_retries": self.n_retries,
+            "n_hops": self.n_hops,
             "degraded": self.degraded,
             "adapter": self.adapter,
         }

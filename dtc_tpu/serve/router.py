@@ -1,0 +1,679 @@
+"""Tenant-aware front-end router over N serving-engine replicas.
+
+The PR 6 single-scheduler guarantees — typed taxonomy (no request ends
+without a :class:`ServeResult`), eviction-and-re-prefill as the universal
+recovery path, shed/degrade backpressure — lifted from one engine to a
+fleet. The router is the piece that turns "an engine" into "a service":
+
+- **placement** — tenant-aware (route a tenant to the replica whose
+  AdapterStore already holds its factors: adapter residency as cache
+  affinity), prefix-cache-aware (shared system prompts route to the
+  replica whose prefix store already holds their KV), least-loaded
+  otherwise; degraded / about-to-shed replicas are deprioritized, and a
+  replica's own admission bound is *respected*, never overridden — when
+  every live replica is full, ``submit()`` raises a typed
+  :class:`~dtc_tpu.serve.request.FleetSaturatedError` (fleet-level
+  backpressure coordinates the per-replica signals).
+- **health** — per-replica heartbeat + the existing hung-step watchdog
+  + each engine's SLO monitor drive a ``healthy → degraded → draining →
+  dead`` state machine (see :mod:`dtc_tpu.serve.replica`).
+- **failover** — the router streams every generated token into its OWN
+  per-request record (a transport would too: the router is what returns
+  tokens to clients), so a dead replica's queued AND in-flight requests
+  re-submit prompt+generated-so-far to survivors through the engine's
+  re-prefill path: completed requests come out token-for-token identical
+  to a clean run, everything else terminal with a typed ``ServeResult``
+  — zero silent drops, chaos-verified (tests/test_router.py,
+  scripts/fleet_smoke.py).
+- **transient faults** — an unreachable replica (chaos
+  ``fleet_partition``) is retried with backoff via
+  ``resilience.retry.retry_call``, then routed around; past the
+  heartbeat-miss budget it is declared dead and failed over.
+- **observability** — each replica's registry carries its replica id as
+  the obs process index (per-replica JSONL shards + Perfetto tracks via
+  the PR 7 machinery unchanged); the router adds fleet-level
+  ``router_ttft_s`` / ``router_ms_per_token`` histograms and a
+  ``router_*`` event schema (route / failover / replica_state / reject),
+  and the mixed-fleet reducer (:func:`dtc_tpu.obs.aggregate.reduce_shards`)
+  rolls per-replica p50/p99 into one fleet view.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import signal
+import time
+from typing import Any, Callable
+
+from dtc_tpu.obs.registry import JsonlSink, MetricsRegistry
+from dtc_tpu.obs.trace import FlightRecorder, Tracer
+from dtc_tpu.resilience.chaos import ChaosInjector
+from dtc_tpu.resilience.events import RecoveryBus
+from dtc_tpu.resilience.retry import retry_call
+from dtc_tpu.serve.engine import ServingEngine
+from dtc_tpu.serve.replica import EngineReplica, ReplicaState
+from dtc_tpu.serve.request import (
+    TERMINAL_STATES,
+    FleetSaturatedError,
+    QueueFullError,
+    ReplicaUnreachableError,
+    Request,
+    RequestFailedError,
+    RequestState,
+    ServeResult,
+    UnknownAdapterError,
+)
+
+PyTree = Any
+
+
+@dataclasses.dataclass
+class FleetRecord:
+    """The router's own copy of one in-flight request's progress — the
+    failover source of truth. A dead replica's memory is gone (in the
+    multi-host picture); what the router re-submits is what IT observed
+    stream back, pulled after every replica step, so the copy is exact
+    at every iteration boundary (where kills land)."""
+
+    req: Request
+    replica: int
+    hops: int = 0
+    tokens: list = dataclasses.field(default_factory=list)
+    submitted_t: float | None = None
+    first_token_t: float | None = None
+    n_evictions: int = 0
+    n_retries: int = 0
+    degraded: bool = False
+
+    def resume_result(self) -> ServeResult:
+        """The partial result a survivor resumes from (the engine's
+        ``submit(resume=...)`` contract)."""
+        return ServeResult(
+            rid=self.req.rid, state=RequestState.EVICTED,
+            tokens=list(self.tokens), submitted_t=self.submitted_t,
+            first_token_t=self.first_token_t, n_evictions=self.n_evictions,
+            n_retries=self.n_retries, degraded=self.degraded,
+            n_hops=self.hops, adapter=self.req.adapter,
+        )
+
+
+class FleetRouter:
+    """See module docstring. Construct once per (model, params, config);
+    ``submit()`` requests, then drive ``step()`` (or ``run()``) exactly
+    like a single engine — the router IS the fleet's scheduler loop."""
+
+    def __init__(
+        self,
+        model,
+        params: PyTree,
+        cfg,
+        *,
+        obs_dir: str = "",
+        clock: Callable[[], float] = time.monotonic,
+        sleep: Callable[[float], None] = time.sleep,
+    ):
+        self.cfg = cfg
+        self.clock = clock
+        self.sleep = sleep
+        # Fleet-level registry: process index ONE PAST the replicas, so
+        # router events/spans land on their own shard/track next to the
+        # per-replica ones in every merged view.
+        self.reg = MetricsRegistry(process_index=cfg.n_replicas)
+        if obs_dir:
+            self.reg.add_sink(
+                JsonlSink(f"{obs_dir}/events.r{cfg.n_replicas}.jsonl")
+            )
+        self.tracer = Tracer(self.reg, tid="router")
+        self.recorder = self.reg.add_sink(FlightRecorder(256))
+        self.bus = RecoveryBus()
+        self.chaos = (
+            ChaosInjector(cfg.chaos, self.bus) if cfg.chaos.enabled else None
+        )
+
+        self.replicas: list[EngineReplica] = []
+        for i in range(cfg.n_replicas):
+            eng = ServingEngine(
+                model, params, cfg.serve, clock=clock, sleep=sleep
+            )
+            # Per-replica fleet observability rides the existing
+            # multi-host machinery: the replica id IS the shard index.
+            eng.reg.process_index = i
+            if obs_dir:
+                eng.reg.add_sink(JsonlSink(f"{obs_dir}/events.r{i}.jsonl"))
+            self.replicas.append(EngineReplica(
+                i, eng, watchdog_cfg=cfg.watchdog, clock=clock,
+            ))
+
+        self.records: dict[str, FleetRecord] = {}   # in flight, fleet-wide
+        self.results: dict[str, ServeResult] = {}   # fleet-terminal
+        self._adapter_factors: dict[str, PyTree] = {}
+        self._bad_it: dict[int, int] = {}   # replica -> last degraded signal
+        self._hung_seen: dict[int, int] = {}
+        self._rr = 0                        # round-robin cursor
+        self._it = 0
+        self._sigterm = False
+        self._prev_sigterm_handler: Any = None
+
+    # ------------------------------------------------------------------
+    # tenants
+    # ------------------------------------------------------------------
+    def register_adapter(self, name: str, factors: PyTree) -> None:
+        """Make tenant ``name``'s factors available to the FLEET. Loading
+        onto a replica is lazy — the first request routed for the tenant
+        loads there, and every later request follows the residency
+        (adapter affinity). The retained tree is also what failover
+        re-loads on a survivor when the tenant's home replica dies."""
+        self._adapter_factors[name] = factors
+
+    def _can_serve_adapter(self, rep: EngineReplica, name: str) -> bool:
+        return name in rep.resident_adapters() or name in self._adapter_factors
+
+    def _ensure_adapter(self, rep: EngineReplica, req: Request) -> None:
+        if req.adapter is None or req.adapter in rep.resident_adapters():
+            return
+        # May raise AdapterStoreFullError (typed) — the caller routes on.
+        rep.engine.load_adapter(
+            req.adapter, self._adapter_factors[req.adapter]
+        )
+        self.reg.counter("router_adapter_loads").inc()
+        self.reg.emit(
+            "router_adapter_load", adapter=req.adapter,
+            replica=rep.replica_id, iteration=self._it,
+        )
+
+    # ------------------------------------------------------------------
+    # placement
+    # ------------------------------------------------------------------
+    def _place(
+        self, req: Request, exclude: set[int]
+    ) -> tuple[EngineReplica | None, str]:
+        """Pick a replica for ``req`` (None + a reason when impossible).
+        Fleet backpressure by construction: only replicas that would
+        ACCEPT the request (accepting state, queue room, able to serve
+        its tenant) are candidates — the router coordinates each
+        replica's admission/shed/degrade signals, it never overrides
+        them."""
+        live = [
+            r for r in self.replicas
+            if r.accepting and r.replica_id not in exclude
+        ]
+        roomy = [r for r in live if r.queue_room > 0]
+        if not roomy:
+            return None, "saturated"
+        if req.adapter is not None:
+            cands = [r for r in roomy if self._can_serve_adapter(r, req.adapter)]
+            if not cands:
+                return None, "unknown_adapter"
+        else:
+            cands = roomy
+
+        def cost(r: EngineReplica):
+            # Healthy before degraded, headroom before about-to-shed,
+            # then least loaded; replica id breaks ties deterministically.
+            return (
+                r.state is ReplicaState.DEGRADED,
+                r.engine.over_shed_watermark,
+                r.load,
+                r.replica_id,
+            )
+
+        if self.cfg.placement == "round_robin":
+            self._rr += 1
+            return cands[self._rr % len(cands)], "round_robin"
+        if self.cfg.placement == "affinity":
+            if req.adapter is not None:
+                hold = [r for r in cands
+                        if req.adapter in r.resident_adapters()]
+                if hold:
+                    return min(hold, key=cost), "adapter_affinity"
+            if req.shared_prefix_len > 0:
+                hit = [r for r in cands if r.has_prefix(req)]
+                if hit:
+                    return min(hit, key=cost), "prefix_affinity"
+        return min(cands, key=cost), "least_loaded"
+
+    def _try_submit(
+        self, rep: EngineReplica, req: Request, resume: ServeResult | None
+    ) -> None:
+        """One replica's submit under the transient-fault retry — a
+        momentarily unreachable replica (partition healing, transport
+        blip) gets ``retry.max_attempts`` with backoff before the router
+        moves on to the next candidate."""
+        r = self.cfg.retry
+        retry_call(
+            lambda: rep.submit(req, resume=resume),
+            transient=(ReplicaUnreachableError,),
+            max_attempts=r.max_attempts, backoff_s=r.backoff_s,
+            backoff_max_s=r.backoff_max_s, jitter=r.jitter,
+            max_elapsed_s=r.max_elapsed_s, on_event=self._on_retry_event,
+            sleep=self.sleep, clock=self.clock,
+        )
+
+    def _route(
+        self, req: Request, *, resume: ServeResult | None = None,
+        exclude: set[int] | None = None,
+    ) -> tuple[EngineReplica, str]:
+        """Place + submit with route-around: a candidate that turns out
+        unreachable (past retries) or full falls out of the pool and the
+        next one is tried; when the pool empties the LAST typed error
+        (or fleet saturation) surfaces — never a silent drop."""
+        tried: set[int] = set(exclude or ())
+        last_err: Exception | None = None
+        while True:
+            rep, reason = self._place(req, exclude=tried)
+            if rep is None:
+                if last_err is not None:
+                    raise last_err
+                if reason == "unknown_adapter":
+                    raise UnknownAdapterError(
+                        f"request {req.rid}: adapter {req.adapter!r} is "
+                        "resident on no live replica and no factors were "
+                        "registered with the router "
+                        "(FleetRouter.register_adapter)"
+                    )
+                raise FleetSaturatedError(
+                    f"request {req.rid}: every live replica's queue is full "
+                    f"({len([r for r in self.replicas if r.accepting])} "
+                    "accepting)"
+                )
+            try:
+                self._ensure_adapter(rep, req)
+                self._try_submit(rep, req, resume)
+                return rep, reason
+            except (ReplicaUnreachableError, QueueFullError) as e:
+                last_err = e
+                tried.add(rep.replica_id)
+            except Exception as e:
+                # AdapterStoreFullError and kin: typed, replica-local —
+                # route on; anything genuinely fatal still surfaces when
+                # the candidate pool runs dry.
+                from dtc_tpu.serve.request import ServeError
+
+                if not isinstance(e, ServeError):
+                    raise
+                last_err = e
+                tried.add(rep.replica_id)
+
+    # ------------------------------------------------------------------
+    # the public surface (mirrors ServingEngine)
+    # ------------------------------------------------------------------
+    def submit(self, req: Request) -> str:
+        """Route one request into the fleet. Raises the same typed
+        taxonomy as ``ServingEngine.submit`` (plus
+        :class:`FleetSaturatedError` — a ``QueueFullError``); an accepted
+        rid is guaranteed a terminal fleet ``ServeResult``."""
+        if req.rid in self.records:
+            raise ValueError(
+                f"request {req.rid}: rid already in flight on replica "
+                f"{self.records[req.rid].replica}"
+            )
+        try:
+            rep, reason = self._route(req)
+        except Exception as e:
+            self.reg.counter("router_rejected").inc()
+            self.reg.emit(
+                "router_reject", rid=req.rid, iteration=self._it,
+                error=type(e).__name__,
+            )
+            raise
+        res = rep.engine.results[req.rid]
+        self.records[req.rid] = FleetRecord(
+            req=req, replica=rep.replica_id, submitted_t=res.submitted_t,
+        )
+        self.reg.counter("router_routed").inc()
+        self.reg.emit(
+            "router_route", rid=req.rid, replica=rep.replica_id,
+            reason=reason, iteration=self._it, adapter=req.adapter,
+        )
+        return req.rid
+
+    def step(self) -> bool:
+        """One fleet iteration: chaos at the boundary, then one scheduler
+        iteration per live replica with token-progress pull, heartbeat
+        accounting, and the health state machine. Returns True while any
+        request is in flight anywhere."""
+        self._it += 1
+        if self.chaos is not None:
+            tgt = min(
+                self.cfg.chaos.fleet_target_replica, len(self.replicas) - 1
+            )
+            stall = self.chaos.fleet_stall_replica(self._it)
+            if stall > 0:
+                self.replicas[tgt].stall(stall)
+            part = self.chaos.fleet_partition(self._it)
+            if part > 0:
+                self.replicas[tgt].partition(part)
+            # Kill consults only with traffic in flight (the deferred-fire
+            # contract: killing an idle fleet would burn the shot on an
+            # injection that proves nothing).
+            if self.records and self.chaos.fleet_kill_replica(self._it):
+                self.kill_replica(tgt, reason="chaos")
+        for rep in self.replicas:
+            if rep.state is ReplicaState.DEAD:
+                continue
+            try:
+                rep.step()
+            except ReplicaUnreachableError:
+                n = rep.miss_beat()
+                self.reg.counter("router_missed_heartbeats").inc()
+                self.reg.emit(
+                    "router_heartbeat_missed", replica=rep.replica_id,
+                    missed=n, iteration=self._it,
+                )
+                if n >= self.cfg.heartbeat_miss_limit:
+                    self.kill_replica(
+                        rep.replica_id,
+                        reason=f"missed {n} heartbeats (partition)",
+                    )
+                continue
+            self._pull(rep)
+            self._update_health(rep)
+        self._drain_bus()
+        return bool(self.records)
+
+    def run(self, *, max_steps: int = 100_000) -> dict[str, ServeResult]:
+        """Drive ``step()`` until the fleet is idle or the per-call
+        budget runs out; a pending SIGTERM (see ``install_sigterm``)
+        triggers the graceful drain instead."""
+        for _ in range(max_steps):
+            if self._sigterm:
+                self.drain()
+                break
+            if not self.step():
+                break
+        return self.results
+
+    # ------------------------------------------------------------------
+    # progress streaming + terminal accounting
+    # ------------------------------------------------------------------
+    def _pull(self, rep: EngineReplica) -> None:
+        eng = rep.engine
+        for rid, rec in self.records.items():
+            if rec.replica != rep.replica_id:
+                continue
+            res = eng.results.get(rid)
+            if res is None or res.state in TERMINAL_STATES:
+                continue
+            rec.tokens = list(res.tokens)
+            rec.first_token_t = res.first_token_t
+            rec.n_evictions = res.n_evictions
+            rec.n_retries = res.n_retries
+            rec.degraded = res.degraded
+        for rid, res in eng.drain_results().items():
+            rec = self.records.pop(rid, None)
+            if rec is None:
+                continue  # not router-managed (warmup / direct submits)
+            self.results[rid] = res
+            self._observe_terminal(res, rec.replica)
+
+    def _observe_terminal(self, res: ServeResult, replica: int) -> None:
+        self.reg.counter(f"router_{res.state.value}").inc()
+        if res.ttft_s is not None:
+            self.reg.histogram("router_ttft_s").observe(res.ttft_s)
+        if res.state is RequestState.DONE:
+            self.reg.counter("router_tokens_out").inc(len(res.tokens))
+            if res.ms_per_token is not None:
+                self.reg.histogram("router_ms_per_token").observe(
+                    res.ms_per_token
+                )
+        if res.n_hops > 0:
+            self.reg.counter("router_failover_terminals").inc()
+
+    # ------------------------------------------------------------------
+    # health + failover
+    # ------------------------------------------------------------------
+    def _update_health(self, rep: EngineReplica) -> None:
+        rid = rep.replica_id
+        hung = rep.hung_flags + (
+            rep.engine.reg.counter("serve_hung_steps").value
+        )
+        bad = hung > self._hung_seen.get(rid, 0) or (
+            rep.engine.slo is not None and rep.engine.slo.degrade_active
+        )
+        self._hung_seen[rid] = hung
+        if bad:
+            self._bad_it[rid] = self._it
+            if rep.state is ReplicaState.HEALTHY:
+                self._transition(rep, ReplicaState.DEGRADED, "health_signal")
+        elif (
+            rep.state is ReplicaState.DEGRADED
+            and self._it - self._bad_it.get(rid, 0)
+            >= self.cfg.degraded_hold_iters
+        ):
+            self._transition(rep, ReplicaState.HEALTHY, "recovered")
+
+    def _transition(
+        self, rep: EngineReplica, state: ReplicaState, reason: str
+    ) -> None:
+        prev = rep.state
+        rep.mark(state, reason=reason)
+        self.reg.counter("router_state_transitions").inc()
+        self.reg.emit(
+            "router_replica_state", replica=rep.replica_id,
+            prev=prev.value, state=state.value, reason=reason,
+            iteration=self._it,
+        )
+
+    def kill_replica(self, replica_id: int, *, reason: str = "killed") -> None:
+        """Declare one replica dead and fail its work over to survivors.
+        The chaos ``fleet_kill_replica`` entry point, and what sustained
+        heartbeat loss escalates to."""
+        rep = self.replicas[replica_id]
+        if rep.state is ReplicaState.DEAD:
+            return
+        self.reg.counter("router_replica_deaths").inc()
+        self._transition(rep, ReplicaState.DEAD, reason)
+        self._failover(rep)
+
+    def _failover(self, dead: EngineReplica) -> None:
+        orphans = [
+            (rid, rec) for rid, rec in self.records.items()
+            if rec.replica == dead.replica_id
+        ]
+        for rid, rec in orphans:
+            if rec.hops + 1 > self.cfg.failover_max_hops:
+                self._terminate(
+                    rid, rec, RequestFailedError(
+                        f"request {rid}: failover budget exhausted "
+                        f"({rec.hops} hops)"
+                    ),
+                )
+                continue
+            try:
+                rep, _reason = self._route(
+                    rec.req, resume=rec.resume_result(),
+                    exclude={dead.replica_id},
+                )
+            except Exception as e:
+                from dtc_tpu.serve.request import ServeError
+
+                if not isinstance(e, ServeError):
+                    raise
+                err = RequestFailedError(
+                    f"request {rid}: no survivor could absorb the failover"
+                )
+                err.__cause__ = e
+                self._terminate(rid, rec, err)
+                continue
+            prev = rec.replica
+            rec.replica = rep.replica_id
+            rec.hops += 1
+            self.reg.counter("router_failovers").inc()
+            self.reg.emit(
+                "router_failover", rid=rid, src=prev,
+                dst=rep.replica_id, tokens_carried=len(rec.tokens),
+                hop=rec.hops, iteration=self._it,
+            )
+
+    def _terminate(
+        self, rid: str, rec: FleetRecord, error: Exception
+    ) -> None:
+        """Router-side typed terminal for a request NO engine owns any
+        more (failover exhausted / no capacity / tenant unservable) —
+        the zero-silent-drop backstop: a ``serve_request`` event still
+        lands in the stream, from the router's own shard."""
+        now = self.clock()
+        res = ServeResult(
+            rid=rid, state=RequestState.FAILED, tokens=list(rec.tokens),
+            error=error, submitted_t=rec.submitted_t,
+            first_token_t=rec.first_token_t, finished_t=now,
+            n_evictions=rec.n_evictions, n_retries=rec.n_retries,
+            n_hops=rec.hops, degraded=rec.degraded, adapter=rec.req.adapter,
+        )
+        del self.records[rid]
+        self.results[rid] = res
+        self._observe_terminal(res, rec.replica)
+        self.reg.emit("serve_request", iteration=self._it, **res.summary())
+        self.recorder_dump(f"router_terminate: {rid}")
+
+    def recorder_dump(self, reason: str) -> None:
+        """In-memory ring only (bare router); kept as a hook so a
+        Telemetry-wired deployment can point it at a file path."""
+
+    # ------------------------------------------------------------------
+    # drain / shutdown
+    # ------------------------------------------------------------------
+    def drain(self, *, max_steps: int | None = None) -> dict[str, ServeResult]:
+        """Router-initiated graceful drain of the whole fleet: every live
+        replica takes the engine shutdown contract (stop admitting,
+        finish or typed-evict, bus drained, flight dumped), terminals are
+        pulled into the fleet results, and every replica retires DEAD
+        ("drained")."""
+        ms = self.cfg.drain_max_steps if max_steps is None else max_steps
+        for rep in self.replicas:
+            if rep.state is ReplicaState.DEAD:
+                continue
+            self._transition(rep, ReplicaState.DRAINING, "drain")
+            rep.engine.shutdown(
+                mode="drain", max_steps=ms,
+                reason=f"router drain (replica {rep.replica_id})",
+            )
+            self._pull(rep)
+            self._transition(rep, ReplicaState.DEAD, "drained")
+        # Anything STILL in records (its replica died unreachable mid-
+        # drain) ends typed — draining must leave zero silent drops.
+        for rid in list(self.records):
+            rec = self.records[rid]
+            self._terminate(
+                rid, rec,
+                RequestFailedError(f"request {rid}: fleet drained"),
+            )
+        self._drain_bus()
+        self.reg.emit("router_drained", iteration=self._it)
+        self.reg.flush()
+        return self.results
+
+    def install_sigterm(self) -> None:
+        """SIGTERM = drain: the serving fleet's preemption contract (the
+        trainer has had this since PR 2). The handler only sets a flag —
+        ``run()`` performs the drain at the next iteration boundary, so
+        no engine state is touched from signal context."""
+        def _handler(signum, frame):
+            print("[dtc_tpu] SIGTERM: draining serving fleet")
+            self._sigterm = True
+
+        self._prev_sigterm_handler = signal.signal(signal.SIGTERM, _handler)
+
+    def restore_sigterm(self) -> None:
+        if self._prev_sigterm_handler is not None:
+            signal.signal(signal.SIGTERM, self._prev_sigterm_handler)
+            self._prev_sigterm_handler = None
+
+    # ------------------------------------------------------------------
+    # bench/test conveniences
+    # ------------------------------------------------------------------
+    def warmup(self, prompt, *, max_new_tokens: int = 2) -> None:
+        """Run one tiny request through EVERY replica (outside the
+        router's records), then reset the latency histograms — the
+        fleet-bench equivalent of serve_bench's warm request, so no
+        replica pays the jit tax inside a measured window. With the
+        engine-level fn cache only the first replica compiles; the rest
+        warm their insert/settle paths."""
+        for rep in self.replicas:
+            if rep.state is ReplicaState.DEAD:
+                continue
+            rep.engine.submit(Request(
+                rid=f"_warm_r{rep.replica_id}", prompt=list(prompt),
+                max_new_tokens=max_new_tokens,
+            ))
+        for _ in range(64):
+            busy = False
+            for rep in self.replicas:
+                if rep.state is not ReplicaState.DEAD:
+                    busy |= rep.step()
+            if not busy:
+                break
+        for rep in self.replicas:
+            rep.engine.drain_results()
+            for name in ("serve_ttft_s", "serve_ms_per_token",
+                         "serve_queue_wait_s"):
+                rep.engine.reg.histogram(name).reset()
+        for name in ("router_ttft_s", "router_ms_per_token"):
+            self.reg.histogram(name).reset()
+
+    def fleet_summary(self) -> dict[str, Any]:
+        """Fleet + per-replica SLO view (the bench row body): router-level
+        p50/p99 over every terminal, per-replica percentiles from each
+        engine's own registry histograms."""
+        from dtc_tpu.utils.percentile import round_opt as r4
+
+        q = lambda h, p: h.percentile(p)  # noqa: E731
+        per = {}
+        for rep in self.replicas:
+            reg = rep.engine.reg
+            per[str(rep.replica_id)] = {
+                "state": rep.state.value,
+                "dead_reason": rep.dead_reason,
+                "done": reg.counter("serve_done").value,
+                "evictions": reg.counter("serve_evictions").value,
+                "hung_flags": rep.hung_flags,
+                "ttft_p50_s": r4(q(reg.histogram("serve_ttft_s"), 0.50)),
+                "ttft_p99_s": r4(q(reg.histogram("serve_ttft_s"), 0.99)),
+                "ms_per_token_p99": r4(
+                    q(reg.histogram("serve_ms_per_token"), 0.99)
+                ),
+            }
+        reg = self.reg
+        return {
+            "n_replicas": len(self.replicas),
+            "replicas": per,
+            "routed": reg.counter("router_routed").value,
+            "rejected": reg.counter("router_rejected").value,
+            "failovers": reg.counter("router_failovers").value,
+            "replica_deaths": reg.counter("router_replica_deaths").value,
+            "tokens_out": reg.counter("router_tokens_out").value,
+            "ttft_p50_s": r4(q(reg.histogram("router_ttft_s"), 0.50)),
+            "ttft_p99_s": r4(q(reg.histogram("router_ttft_s"), 0.99)),
+            "ms_per_token_p50": r4(
+                q(reg.histogram("router_ms_per_token"), 0.50)
+            ),
+            "ms_per_token_p99": r4(
+                q(reg.histogram("router_ms_per_token"), 0.99)
+            ),
+        }
+
+    # ------------------------------------------------------------------
+    # plumbing
+    # ------------------------------------------------------------------
+    def _on_retry_event(self, etype: str, **fields: Any) -> None:
+        self.reg.counter("router_retries").inc()
+        self.bus.post(etype, **fields)
+
+    def _drain_bus(self) -> None:
+        for etype, fields in self.bus.drain():
+            if etype == "chaos":
+                self.reg.counter("chaos_injections").inc()
+            fields.setdefault("iteration", self._it)
+            self.reg.emit(etype, **fields)
+
+    def close(self) -> None:
+        """Release file sinks (replica shards + the router's own) and
+        give back the SIGTERM handler if ``install_sigterm`` took it — a
+        retired router must not keep swallowing the process's signals
+        (or keep itself alive through the handler closure)."""
+        self.restore_sigterm()
+        for rep in self.replicas:
+            rep.engine.reg.flush()
+            rep.engine.reg.close()
+        self.reg.flush()
+        self.reg.close()

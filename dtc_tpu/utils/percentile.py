@@ -15,6 +15,13 @@ import math
 from typing import Iterable
 
 
+def round_opt(v: float | None, ndigits: int = 4) -> float | None:
+    """Round a possibly-``None`` metric — the one rounding rule every
+    percentile surface shares (the mixed-fleet reducer, the router's
+    fleet summary, bench rows), so a policy change lands once."""
+    return None if v is None else round(v, ndigits)
+
+
 def nearest_rank(vals: Iterable[float], q: float) -> float | None:
     """Nearest-rank percentile of ``vals`` at quantile ``q`` in [0, 1].
 

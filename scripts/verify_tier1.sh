@@ -15,8 +15,9 @@ timeout -k 10 120 env JAX_PLATFORMS=cpu python -m pytest --collect-only -q -p no
   tests/test_generate.py tests/test_decode_fused.py tests/test_metrics.py \
   tests/test_analysis.py \
   tests/test_serve.py tests/test_trace.py tests/test_devprof.py \
-  tests/test_adapters.py tests/test_overlap_collectives.py > /dev/null || {
-    echo "tier-1 pre-gate: MoE/HLO/decode/analysis/serve/trace/devprof/adapters/overlap test collection failed" >&2; exit 1; }
+  tests/test_adapters.py tests/test_overlap_collectives.py \
+  tests/test_router.py > /dev/null || {
+    echo "tier-1 pre-gate: MoE/HLO/decode/analysis/serve/trace/devprof/adapters/overlap/router test collection failed" >&2; exit 1; }
 # Pre-gate 2 (ISSUE 5 + 6): the graph audit — lower/compile the
 # dp/tp/fsdp/ep train steps (8-virtual-device CPU mesh), the greedy decode
 # scan, AND the serving (continuous-batching) decode step; run the rule
@@ -81,4 +82,14 @@ timeout -k 10 480 env JAX_PLATFORMS=cpu python scripts/devprof_smoke.py || {
 # recompiles across the mixed-tenant admissions. ~1-2 min.
 timeout -k 10 300 env JAX_PLATFORMS=cpu python scripts/adapter_smoke.py || {
     echo "tier-1 pre-gate: adapter-loop smoke failed" >&2; exit 1; }
+# Pre-gate 7 (ISSUE 13): serving-fleet smoke — 3 in-process replicas of
+# the tiny audit model with two LoRA tenants + base traffic and a shared
+# system prompt, one chaos replica-kill mid-traffic targeting a tenant's
+# affinity home. Asserts zero silent drops (submits reconciled against
+# terminal results), survivor re-prefill token-identity for EVERY
+# completed request (failover hops included — proves the adapter-reload-
+# on-survivor path, since base-weight decode would fork the tokens), and
+# tenant/prefix affinity actually routing. ~1-2 min.
+timeout -k 10 300 env JAX_PLATFORMS=cpu python scripts/fleet_smoke.py || {
+    echo "tier-1 pre-gate: serving-fleet smoke failed" >&2; exit 1; }
 set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc
