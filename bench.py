@@ -492,6 +492,59 @@ def fsdp_overlap_bench(
     return res
 
 
+def precision_ab_bench(
+    precision: str = "fp32", batch: int = 8, bench_steps: int = 20,
+) -> dict:
+    """One leg of the ISSUE 14 mixed-precision A/B: the flagship dp train
+    step under ``OptimConfig.precision`` — tokens/s PLUS the analytic
+    per-device HBM budget (``utils/metrics.train_memory_bytes``), so the
+    row carries both the speed and the byte story the static memory audit
+    pins (params halved, +4 B/param fp32 masters, bf16 grads on the
+    wire). Same-config drift rule: the row carries precision/platform/
+    devices. CPU legs are shape-only (this host EMULATES bf16 — often
+    slower than fp32); the TPU A/B is the real number
+    (wired-but-unmeasured while the tunnel is down, PERF.md ISSUE-14
+    round)."""
+    import jax
+
+    from dtc_tpu.config.schema import OptimConfig
+    from dtc_tpu.train.train_step import resolve_precision
+    from dtc_tpu.utils.metrics import mfu as mfu_fn
+    from dtc_tpu.utils.metrics import train_memory_bytes
+    from scripts.bench_common import time_step
+
+    ms = time_step(
+        steps=bench_steps, warmup=4, batch=batch, parallel="dp",
+        precision=precision, remat=False, dropout=0.0,
+    )
+    model_cfg = resolve_precision(
+        OptimConfig(lr=3e-4, weight_decay=0.1, grad_clip=1.0,
+                    precision=precision),
+        flagship_model_cfg(remat=False, dropout=0.0),
+    )
+    mesh_shape = {"data": jax.device_count()}
+    mem = train_memory_bytes(
+        model_cfg, batch, model_cfg.max_seq_len, mesh_shape, "dp",
+        precision=precision,
+    )
+    step_time = ms / 1e3
+    u = mfu_fn(model_cfg, batch, model_cfg.max_seq_len, step_time,
+               jax.device_count())
+    return {
+        "precision": precision,
+        "platform": jax.default_backend(),
+        "devices": jax.device_count(),
+        "step_time_s": round(step_time, 5),
+        "tokens_per_sec": round(batch * model_cfg.max_seq_len / step_time, 1),
+        "mfu": round(u, 4) if u is not None else None,
+        "hbm_params_bytes": round(mem["params"]),
+        "hbm_master_bytes": round(mem["master"]),
+        "hbm_moments_bytes": round(mem["moments"]),
+        "hbm_grads_bytes": round(mem["grads"]),
+        "hbm_total_bytes": round(mem["total"]),
+    }
+
+
 def serve_bench(
     rps: float | None,
     *,
@@ -1357,6 +1410,14 @@ def main(argv: list[str] | None = None) -> None:
          lambda: fsdp_overlap_bench(collectives="xla")))
     emit("fsdp_overlap_ab_overlapped", _safe("fsdp_overlap_ab_overlapped",
          lambda: fsdp_overlap_bench(collectives="overlapped")))
+    # Mixed-precision A/B (ISSUE 14): the SAME flagship dp step under
+    # precision fp32 vs bf16_mixed — tokens/s + the analytic HBM budget
+    # (params/masters/moments/grads). CPU legs are shape-only (bf16 is
+    # emulated here); the TPU pair is the real speed number.
+    emit("precision_ab_fp32", _safe("precision_ab_fp32",
+         lambda: precision_ab_bench(precision="fp32")))
+    emit("precision_ab_bf16", _safe("precision_ab_bf16",
+         lambda: precision_ab_bench(precision="bf16_mixed")))
     emit("ring_block_smoke", _safe("ring_block_smoke", ring_block_smoke))
 
     # Assemble the detail line FROM the registry's event stream: each

@@ -19,11 +19,24 @@ compiled program itself an asserted artifact:
 - :mod:`hostsync` — AST lint of the trainer's timed loop for host
   synchronization (``device_get`` / ``block_until_ready`` / ``.item()``)
   outside the sanctioned boundaries;
-- :mod:`rules` — the rule engine: five families (collective census +
+- :mod:`numerics` — dtype-flow over the StableHLO lowering (ISSUE 14):
+  dot-operand-signature census, fp32-mandatory region checks, and the
+  origin-matched per-layer cast-placement lint — the pass that certifies
+  the ``bf16_mixed`` training mode actually lowered;
+- :mod:`memory` — the static per-entry HBM plan (params / masters /
+  moments / activations / comm buffers), verified against the module's
+  entry layout and warn-band cross-checked against
+  ``utils/metrics.train_memory_bytes``;
+- :mod:`dtypelint` — hostsync-style AST lint for hard-coded dtype
+  literals in model/op hot paths outside the sanctioned
+  mandated-precision scopes;
+- :mod:`rules` — the rule engine: eight families (collective census +
   forbidden gathers, donation audit, dtype/promotion audit, host-sync
-  lint, recompile fingerprint) producing severity-ranked findings;
-- :mod:`report` — JSON report assembly, per-entry-point fingerprints,
-  committed-baseline read/write/diff (the drift gate).
+  lint, recompile fingerprint, numerics/dtype-flow, static memory plan,
+  dtype-literal lint) producing severity-ranked findings;
+- :mod:`report` — JSON report assembly, per-entry-point fingerprints
+  (graph + ``.numerics`` + ``.memory`` sections, each its own committed
+  file), committed-baseline read/write/diff (the drift gate).
 
 ``scripts/audit_graph.py`` is the CLI; ``scripts/verify_tier1.sh`` runs
 it as a pre-gate; ``tests/test_collectives_hlo.py`` asserts through the

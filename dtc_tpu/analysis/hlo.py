@@ -26,6 +26,7 @@ from __future__ import annotations
 import math
 import re
 from collections import Counter
+from typing import Any
 
 #: The cross-device ops the census tracks (collective-permute carries
 #: pipeline/ring traffic; the other four are the GSPMD workhorses).
@@ -169,6 +170,68 @@ def collective_census(txt: str) -> dict[str, dict[str, int]]:
     if cc["count"]:
         census["pallas_custom_call"] = cc
     return census
+
+
+def collective_dtype_census(txt: str) -> dict[str, dict[str, int]]:
+    """Per-collective result-DTYPE counts, e.g.
+    ``{"all-reduce": {"f32": 2, "bf16": 1}}`` — the numerics pass' view
+    of what rides the wire (ISSUE 14). Under a declared-fp32 policy a
+    bf16 collective is a downcast leak; under ``bf16_mixed`` the bf16
+    gradient all-reduce is the documented wire choice and the baseline
+    pins the split. Tuple results contribute one count per element
+    buffer (the combined-op flattening rule every parser here follows).
+    NOTE: XLA's CPU pipeline PROMOTES bf16 all-reduces to f32
+    (AllReducePromotion), so CPU baselines show the promoted dtype — a
+    TPU dump shows the true wire dtype; same env-scoping as the rest of
+    the census."""
+    out: dict[str, dict[str, int]] = {}
+    for m in _RESULT.finditer(txt):
+        type_text, op = m.group(1), m.group(2)
+        row = out.setdefault(op, {})
+        for dtype, _dims in _BUFFER.findall(type_text):
+            row[dtype] = row.get(dtype, 0) + 1
+    return out
+
+
+#: a dot line in OPTIMIZED HLO: `%name = <type> dot(<operands>), ...`.
+_DOT_LINE = re.compile(r"%[\w.-]+ = (\S+) dot\((.*?)\)(.*)$")
+
+#: the accumulation-algorithm attribute some TPU dots carry, e.g.
+#: `algorithm=dot_bf16_bf16_f32` (bf16 inputs, fp32 accumulation).
+_DOT_ALGORITHM = re.compile(r"algorithm=([\w]+)")
+
+
+def dot_entries(txt: str) -> list[dict[str, Any]]:
+    """Structured view of every ``dot`` in OPTIMIZED HLO text:
+    ``{"result_dtype", "operand_dtypes", "algorithm", "op_name"}``.
+
+    This is the TPU-dump counterpart of the StableHLO dot census in
+    :mod:`dtc_tpu.analysis.numerics`: on CPU the optimized HLO is
+    useless for dtype policy (the backend legalizes bf16 dots to f32 —
+    the reason the numerics rules read StableHLO), but a TPU dump keeps
+    bf16 and adds the ``algorithm=`` attribute naming the accumulation
+    dtype — ``dot_bf16_bf16_f32`` is the MXU's bf16-in/fp32-accumulate
+    contract, which a dtype-region audit must NOT misread as an fp32
+    upcast (tests/test_analysis.py pins that case on a fabricated
+    dump)."""
+    out = []
+    for line in txt.splitlines():
+        m = _DOT_LINE.search(line)
+        if m is None:
+            continue
+        result_type, operands, attrs = m.groups()
+        res = _BUFFER.search(result_type)
+        alg = _DOT_ALGORITHM.search(attrs)
+        scope = _LINE_OP_NAME.search(line)
+        out.append({
+            "result_dtype": res.group(1) if res else "",
+            "operand_dtypes": tuple(
+                d for d, _ in _BUFFER.findall(operands)
+            ),
+            "algorithm": alg.group(1) if alg else "",
+            "op_name": scope.group(1) if scope else "",
+        })
+    return out
 
 
 def all_gather_shapes(txt: str) -> list[str]:

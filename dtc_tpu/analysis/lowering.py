@@ -23,6 +23,7 @@ trainer runs. Fidelity notes that make the audit representative:
 from __future__ import annotations
 
 import dataclasses
+import math
 from typing import Any, Sequence
 
 import jax
@@ -65,8 +66,10 @@ def audit_model_cfg(**overrides: Any) -> ModelConfig:
     return ModelConfig(**base)
 
 
-def audit_opt_cfg() -> OptimConfig:
-    return OptimConfig(lr=1e-3, weight_decay=0.1, grad_clip=1.0)
+def audit_opt_cfg(precision: str = "fp32") -> OptimConfig:
+    return OptimConfig(
+        lr=1e-3, weight_decay=0.1, grad_clip=1.0, precision=precision
+    )
 
 
 def audit_train_cfg(parallel: str, mesh: MeshConfig) -> TrainConfig:
@@ -86,6 +89,11 @@ class EntrySpec:
     mesh: MeshConfig
     model_overrides: dict[str, Any]
     rules: str  # "default" | "fsdp" | "ring"
+    # Training precision policy (OptimConfig.precision): "bf16_mixed"
+    # lifts bf16 param/compute dtypes onto the model config through the
+    # trainer's own resolve_precision, so the audited program IS the
+    # trained program (ISSUE 14).
+    precision: str = "fp32"
 
 
 #: The registry. ``dp/tp/fsdp/ep`` are the audit CLI's default set (the
@@ -125,6 +133,18 @@ TRAIN_ENTRIES: dict[str, EntrySpec] = {
         "3d", "fsdp", MeshConfig(pipe=1, data=4, model=2),
         dict(collectives="overlapped"), "fsdp",
     ),
+    # ISSUE 14 — the bf16_mixed training mode the numerics + memory
+    # passes certify: bf16 params + bf16 matmuls (resolve_precision lifts
+    # them from the opt config), fp32 masters + moments in the optimizer
+    # (with_master_weights), bf16 grads on the dp all-reduce wire. Same
+    # dp mesh as the fp32 reference entry, so the two baselines are an
+    # A/B of the policy alone. (The CPU backend legalizes bf16 DOTS to
+    # f32 in the optimized HLO — which is why the numerics rules read the
+    # StableHLO — but compiles and runs this program fine; the bf16
+    # collective crash class in tests/conftest.py is pipeline-specific.)
+    "bf16": EntrySpec(
+        "bf16", "dp", MeshConfig(), {}, "default", precision="bf16_mixed",
+    ),
 }
 
 _RULE_TABLES = {
@@ -161,6 +181,97 @@ class Artifact:
     cold_compiles: int | None = None   # None = not executed
     steady_compiles: int | None = None
     comm_estimate: dict[str, float] | None = None
+    # --- ISSUE 14: numerics + memory evidence ---
+    precision: str = "fp32"            # declared policy (OptimConfig.precision)
+    loss_dtype: str = ""               # jaxpr dtype of the loss output ("" = n/a)
+    # Exact per-device LOCAL bytes of the live placed state, classified
+    # by pytree path: params / opt_master / opt_moments / opt_other
+    # (+ cache / lora_stack for serving entries). The module-side
+    # entry-layout bytes verify this decomposition (analysis/memory.py).
+    state_bytes: dict[str, int] | None = None
+    # Distinct dtypes per state class, e.g. {"opt_moments": ["f32"]} —
+    # the optimizer-state numerics rule reads these.
+    state_dtypes: dict[str, list[str]] | None = None
+    batch_bytes: int = 0               # non-state entry inputs (tokens, rng, idx)
+    # XLA's CompiledMemoryStats (argument/output/temp/alias bytes). The
+    # CPU backend DOES report temp for real modules (the audit plans use
+    # it as the measured activation row); where a backend reports 0/none,
+    # the memory plan falls back to the analytic estimate and says so.
+    mem_stats: dict[str, int] | None = None
+    # utils/metrics.train_memory_bytes for train entries (None elsewhere)
+    # — the analytic cross-check target.
+    mem_estimate: dict[str, float] | None = None
+
+
+def _local_nbytes(leaf: Any) -> int:
+    """Per-device LOCAL bytes of one placed array (its shard shape under
+    the committed sharding; the full shape for unsharded/abstract
+    leaves) — the same basis the GSPMD module's entry layout uses."""
+    shape = tuple(int(d) for d in getattr(leaf, "shape", ()))
+    sharding = getattr(leaf, "sharding", None)
+    if sharding is not None and hasattr(sharding, "shard_shape"):
+        try:
+            shape = tuple(int(d) for d in sharding.shard_shape(shape))
+        except Exception:
+            pass
+    itemsize = np.dtype(leaf.dtype).itemsize if hasattr(leaf, "dtype") else 4
+    return math.prod(shape) * itemsize if shape else itemsize
+
+
+#: HLO dtype token per numpy dtype name, for state_dtypes entries.
+def _hlo_dtype(leaf: Any) -> str:
+    return _NP_TO_HLO.get(str(np.dtype(leaf.dtype)), str(leaf.dtype))
+
+
+def _classify_state(state: Any) -> tuple[dict[str, int], dict[str, list[str]]]:
+    """(bytes, dtypes) of a TrainState's leaves by class, keyed on the
+    pytree PATH — the one place the params/master/moments split is ground
+    truth (optax state is named tuples: ``.mu``/``.nu`` are the AdamW
+    moments, ``.master`` the with_master_weights fp32 copies; everything
+    else in opt_state is counts/clip bookkeeping)."""
+    import jax.tree_util as jtu
+
+    bytes_by: dict[str, int] = {}
+    dtypes_by: dict[str, set[str]] = {}
+    for path, leaf in jtu.tree_flatten_with_path(state)[0]:
+        key = jtu.keystr(path)
+        if key.startswith(".params"):
+            cls = "params"
+        elif ".master" in key:
+            cls = "opt_master"
+        elif ".mu" in key or ".nu" in key:
+            cls = "opt_moments"
+        elif key.startswith(".opt_state"):
+            cls = "opt_other"
+        else:
+            cls = "opt_other"  # .step and friends: scalar bookkeeping
+        bytes_by[cls] = bytes_by.get(cls, 0) + _local_nbytes(leaf)
+        dtypes_by.setdefault(cls, set()).add(_hlo_dtype(leaf))
+    return bytes_by, {k: sorted(v) for k, v in dtypes_by.items()}
+
+
+def _compiled_mem_stats(compiled: Any) -> dict[str, int] | None:
+    """argument/output/temp/alias bytes from XLA's memory analysis (None
+    when the backend does not report one)."""
+    try:
+        ma = compiled.memory_analysis()
+    except Exception:
+        return None
+    if ma is None:
+        return None
+    return {
+        "argument": int(getattr(ma, "argument_size_in_bytes", 0) or 0),
+        "output": int(getattr(ma, "output_size_in_bytes", 0) or 0),
+        "temp": int(getattr(ma, "temp_size_in_bytes", 0) or 0),
+        "alias": int(getattr(ma, "alias_size_in_bytes", 0) or 0),
+    }
+
+
+def _loss_dtype(traced: Any) -> str:
+    """HLO dtype token of the step's LOSS output (the last flattened
+    outvar — the steps return ``(state, loss)``)."""
+    avals = traced.jaxpr.out_avals
+    return _NP_TO_HLO.get(str(np.dtype(avals[-1].dtype)), "?")
 
 
 def _param_shapes(params: Any) -> list[tuple[str, tuple[int, ...]]]:
@@ -286,9 +397,17 @@ def build_train_artifact(mode: str, *, execute: bool = True) -> Artifact:
     """Lower + compile one registry train entry and collect the evidence
     the rules audit. ``execute=True`` additionally runs the step twice for
     the recompile fingerprint (adds device time, CPU-cheap at this size)."""
+    from dtc_tpu.train.train_step import resolve_precision
+    from dtc_tpu.utils.metrics import train_memory_bytes
+
     spec = TRAIN_ENTRIES[mode]
-    model_cfg = audit_model_cfg(**spec.model_overrides)
-    opt_cfg = audit_opt_cfg()
+    opt_cfg = audit_opt_cfg(spec.precision)
+    # The SAME resolution the trainer applies: bf16_mixed lifts bf16
+    # param/compute dtypes onto the model config — the audited lowering
+    # and the trained lowering share one definition by construction.
+    model_cfg = resolve_precision(
+        opt_cfg, audit_model_cfg(**spec.model_overrides)
+    )
     rules = _RULE_TABLES[spec.rules]
     mesh, step, state, batch, rng = _lower_train_step(
         spec.parallel, spec.mesh, model_cfg, opt_cfg, rules
@@ -296,11 +415,17 @@ def build_train_artifact(mode: str, *, execute: bool = True) -> Artifact:
     with mesh, nn.logical_axis_rules(rules):
         lowered = step.lower(state, batch, rng)
         stablehlo = lowered.as_text()
-        hlo = lowered.compile().as_text()
+        compiled = lowered.compile()
+        hlo = compiled.as_text()
         traced = step.trace(state, batch, rng)
         weak = sum(
             1 for v in traced.jaxpr.jaxpr.outvars
             if getattr(v.aval, "weak_type", False)
+        )
+        state_bytes, state_dtypes = _classify_state(state)
+        batch_bytes = (
+            _local_nbytes(batch.x) + _local_nbytes(batch.y)
+            + _local_nbytes(rng)
         )
         cold = steady = None
         if execute:
@@ -331,6 +456,16 @@ def build_train_artifact(mode: str, *, execute: bool = True) -> Artifact:
             comm_estimate=comm_bytes_per_step(
                 model_cfg, int(batch.x.shape[0]), model_cfg.max_seq_len, mesh_shape,
                 spec.parallel,
+            ),
+            precision=spec.precision,
+            loss_dtype=_loss_dtype(traced),
+            state_bytes=state_bytes,
+            state_dtypes=state_dtypes,
+            batch_bytes=batch_bytes,
+            mem_stats=_compiled_mem_stats(compiled),
+            mem_estimate=train_memory_bytes(
+                model_cfg, int(batch.x.shape[0]), model_cfg.max_seq_len,
+                mesh_shape, spec.parallel, precision=spec.precision,
             ),
         )
 
@@ -363,7 +498,8 @@ def build_decode_artifact(
     kwargs = dict(temperature=0.0)
     lowered = _generate_jit.lower(*args, **kwargs)
     stablehlo = lowered.as_text()
-    hlo = lowered.compile().as_text()
+    compiled = lowered.compile()
+    hlo = compiled.as_text()
     traced = _generate_jit.trace(*args, **kwargs)
     weak = sum(
         1 for v in traced.jaxpr.jaxpr.outvars
@@ -396,6 +532,16 @@ def build_decode_artifact(
         cold_compiles=cold,
         steady_compiles=steady,
         comm_estimate=None,
+        state_bytes={
+            "params": sum(_local_nbytes(p) for p in jax.tree.leaves(params)),
+        },
+        state_dtypes={
+            "params": sorted({
+                _hlo_dtype(p) for p in jax.tree.leaves(params)
+            }),
+        },
+        batch_bytes=_local_nbytes(prompt) + _local_nbytes(args[4]),
+        mem_stats=_compiled_mem_stats(compiled),
     )
 
 
@@ -462,12 +608,24 @@ def build_serve_artifact(
         args = (params, eng.cache, toks)
     lowered = eng._step_fn.lower(*args)
     stablehlo = lowered.as_text()
-    hlo = lowered.compile().as_text()
+    compiled = lowered.compile()
+    hlo = compiled.as_text()
     traced = eng._step_fn.trace(*args)
     weak = sum(
         1 for v in traced.jaxpr.jaxpr.outvars
         if getattr(v.aval, "weak_type", False)
     )
+    # Byte decomposition of the step's resident inputs, taken BEFORE the
+    # execution passes below mutate the engine (shapes never change —
+    # that is the audited invariant — but the cache object is reassigned).
+    serve_state_bytes = {
+        "params": sum(_local_nbytes(p) for p in jax.tree.leaves(params)),
+        "cache": sum(_local_nbytes(c) for c in jax.tree.leaves(eng.cache)),
+    }
+    if lora:
+        serve_state_bytes["lora_stack"] = sum(
+            _local_nbytes(f) for f in jax.tree.leaves(eng.lora_stack)
+        )
     cold = steady = None
     if execute:
         # Warm every helper an admission (and, lora flavor, an adapter
@@ -525,6 +683,16 @@ def build_serve_artifact(
         cold_compiles=cold,
         steady_compiles=steady,
         comm_estimate=None,
+        state_bytes=serve_state_bytes,
+        state_dtypes={
+            "params": sorted({
+                _hlo_dtype(p) for p in jax.tree.leaves(params)
+            }),
+        },
+        batch_bytes=_local_nbytes(toks) + (
+            _local_nbytes(jnp.asarray(eng.slot_adapter)) if lora else 0
+        ),
+        mem_stats=_compiled_mem_stats(compiled),
     )
 
 

@@ -1,0 +1,177 @@
+"""Static HBM plan per audited entry (ISSUE 14).
+
+One deterministic byte budget per compiled program, decomposed the way an
+HBM capacity question is actually asked: params / optimizer state (master
+weights vs AdamW moments) / activations / communication buffers / IO.
+Three independent sources feed it, cross-checked against each other:
+
+- **The compiled module**: entry parameter + result buffer bytes parsed
+  from the ``entry_computation_layout`` header (per-device LOCAL shapes —
+  GSPMD has already split the tree), the donation alias map, and the
+  collective census' result-buffer bytes (:mod:`dtc_tpu.analysis.hlo`).
+  XLA's own ``memory_analysis()`` numbers (argument/output/temp/alias
+  bytes) ride along — this CPU backend DOES report temp for real
+  modules, so the activation row is usually MEASURED even off-TPU; where
+  a backend reports 0/none the row falls back to the analytic estimate
+  and says so (``activations_source``), the wired-but-unmeasured honesty
+  rule the bench tables follow.
+- **The live state**: exact per-leaf local bytes of the placed TrainState,
+  classified by pytree path into params / fp32 masters / AdamW moments /
+  other (counts, clip state) — computed in lowering.py where the arrays
+  exist, recorded on the Artifact. The decomposition is VERIFIED against
+  the module: state + batch + rng bytes must equal the entry layout's
+  input bytes (``entry_decomposition`` check), so the classification can
+  never silently rot away from the program it describes.
+- **The analytic model**: ``utils/metrics.train_memory_bytes`` — the
+  closed-form params + masters + moments + grads + activation estimate +
+  comm-buffer budget. The plan total is cross-checked against it in a
+  wide warn-band (same [1/8, 8] philosophy as the collective census
+  cross-check: the estimate is structural, the band catches 100x
+  accounting bugs, the committed baselines pin the exact numbers).
+
+The obs ``memory_stats`` watermark closes the loop where a real device is
+available (:func:`device_watermark_bytes` — PJRT reports no stats on this
+CPU host, so the audit prints the wired-but-unmeasured note instead).
+
+Pure string/dict processing except the explicitly-lazy device query — no
+module-level JAX import, same contract as :mod:`dtc_tpu.analysis.hlo`.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any
+
+from dtc_tpu.analysis import hlo
+
+#: entry_computation_layout={(IN...)->(OUT...)} on the HloModule header.
+_ENTRY_LAYOUT = re.compile(r"entry_computation_layout=\{\((.*?)\)->")
+
+
+def _entry_io_split(hlo_text: str) -> tuple[str, str]:
+    """(inputs text, outputs text) of the header's entry layout. The
+    output side can itself be a tuple ``(...)``; split on the ``)->``
+    that separates the two top-level groups."""
+    header = hlo_text.split("\n", 1)[0]
+    m = _ENTRY_LAYOUT.search(header)
+    ins = m.group(1) if m else ""
+    outs = ""
+    if m:
+        rest = header[m.end():]
+        # Output group: everything to the layout attribute's closing
+        # brace. Buffer regexes don't care about trailing attrs, so a
+        # greedy cut to the next '}' top-level is fine for byte sums.
+        depth = 0
+        for i, ch in enumerate(rest):
+            if ch == "{":
+                depth += 1
+            elif ch == "}":
+                if depth == 0:
+                    outs = rest[:i]
+                    break
+                depth -= 1
+        else:
+            outs = rest
+    return ins, outs
+
+
+def entry_input_bytes(hlo_text: str) -> int:
+    """Total bytes of the module's entry parameters (per-device local
+    shapes in a GSPMD module)."""
+    ins, _ = _entry_io_split(hlo_text)
+    return hlo._buffer_bytes(ins)
+
+
+def entry_output_bytes(hlo_text: str) -> int:
+    """Total bytes of the module's entry results."""
+    _, outs = _entry_io_split(hlo_text)
+    return hlo._buffer_bytes(outs)
+
+
+def hbm_plan(a: Any) -> dict[str, Any]:
+    """The static HBM plan of one lowered entry (``a`` is an
+    :class:`~dtc_tpu.analysis.lowering.Artifact`). All integers, all
+    deterministic — report.py commits it as ``<entry>.memory.json``.
+
+    Components (per-device bytes):
+
+    - ``params`` / ``opt_master`` / ``opt_moments`` / ``opt_other``: the
+      live state's exact local bytes by class (plus ``cache`` /
+      ``lora_stack`` for the serving entries).
+    - ``batch_io``: the non-state entry inputs (token batch, rng, slot
+      indices).
+    - ``comm_buffers``: collective result-buffer bytes from the census —
+      the transient buffers the collectives land in.
+    - ``activations``: XLA's measured temp bytes when the backend reports
+      them (TPU), else the analytic activation estimate
+      (``activations_source`` says which — "xla_temp" or "analytic").
+    - ``entry_inputs`` / ``entry_outputs`` / ``alias_count``: the
+      module-side ground truth the decomposition is checked against.
+    - ``undonated_output``: result bytes not aliased onto an input — the
+      extra residency a step with dropped donations would pay (the
+      donation rule errors on that separately; this is the byte view).
+    - ``total``: state + batch_io + activations + comm_buffers — the
+      static residency estimate for one in-flight step.
+    """
+    # Per-artifact memo: the rule pass, the baseline fingerprint, and the
+    # CLI's byte-table print all need this identical deterministic plan —
+    # computing it once also guarantees they can never be built from
+    # divergent inputs. (Evidence fields never mutate after lowering.)
+    cached = getattr(a, "_hbm_plan_cache", None)
+    if cached is not None:
+        return cached
+    census = hlo.collective_census(a.hlo_text)
+    comm = int(sum(row["bytes"] for row in census.values()))
+    sb = dict(a.state_bytes or {})
+    mem = a.mem_stats or {}
+    est = a.mem_estimate or {}
+    temp = int(mem.get("temp", 0) or 0)
+    if temp > 0:
+        acts, acts_src = temp, "xla_temp"
+    else:
+        acts, acts_src = int(est.get("activations", 0)), "analytic"
+    ins = entry_input_bytes(a.hlo_text)
+    outs = entry_output_bytes(a.hlo_text)
+    state_total = int(sum(sb.values()))
+    # Donated outputs reuse their input buffers; anything beyond the
+    # aliased byte count is fresh residency. alias bytes come from
+    # memory_analysis when present, else assume full donation coverage
+    # of the state (the donation rule audits the count separately).
+    alias_bytes = int(mem.get("alias", 0) or 0)
+    if alias_bytes == 0 and hlo.input_output_alias_count(a.hlo_text):
+        alias_bytes = min(state_total, outs)
+    plan = {
+        **{k: int(v) for k, v in sorted(sb.items())},
+        "batch_io": int(a.batch_bytes or 0),
+        "comm_buffers": comm,
+        "activations": acts,
+        "activations_source": acts_src,
+        "entry_inputs": ins,
+        "entry_outputs": outs,
+        "alias_count": hlo.input_output_alias_count(a.hlo_text),
+        "undonated_output": max(outs - alias_bytes, 0),
+        "total": state_total + int(a.batch_bytes or 0) + acts + comm,
+    }
+    try:
+        a._hbm_plan_cache = plan
+    except (AttributeError, TypeError):
+        pass  # frozen/slotted artifact stand-ins in tests: just recompute
+    return plan
+
+
+def device_watermark_bytes() -> int | None:
+    """Peak device memory from PJRT ``memory_stats`` — the obs
+    watermark's source (obs/device.py). None when the backend keeps no
+    stats (this CPU host): the audit then prints the wired-but-unmeasured
+    note instead of a fake cross-check. Lazy jax import on purpose — the
+    rest of this module stays importable without a backend."""
+    try:
+        import jax
+
+        stats = jax.local_devices()[0].memory_stats()
+    except Exception:
+        return None
+    if not stats:
+        return None
+    peak = stats.get("peak_bytes_in_use") or stats.get("bytes_in_use")
+    return int(peak) if peak else None

@@ -1,0 +1,464 @@
+"""Dtype-flow analysis over lowered StableHLO text (ISSUE 14).
+
+Why StableHLO and not the optimized HLO: XLA's CPU pipeline LEGALIZES
+small dtypes — a bf16 ``dot_general`` compiles to an f32 dot on this
+host — so the only place a declared mixed-precision policy is faithfully
+visible off-TPU is the backend-independent lowering. (The existing
+``hlo.dot_dtype_counts`` learned this in PR 5; this module is the full
+dtype-flow generalization.) Everything here is pure string processing —
+no JAX imports — same contract as :mod:`dtc_tpu.analysis.hlo`.
+
+What the parsers recover, and the rules built on them (rules.py
+``audit_numerics``):
+
+- **Matmul precision regions** (:func:`dot_signature_census`): every
+  ``stablehlo.dot_general`` classified by its OPERAND dtypes. ``bf16 ×
+  bf16`` and mixed ``bf16``-operand dots (an f32-accumulating
+  ``preferred_element_type`` score dot has bf16 operands and an f32
+  result — the MXU ideal, NOT a leak) are the bf16 region; ``f32 × f32``
+  dots are legitimate only when their operands are natively f32 (the
+  fp32-mandated softmax neighborhood's backward). An ``f32 × f32`` dot
+  whose operand was just UPCAST from bf16 (``convert`` bf16->f32 feeding
+  the dot) is the classic silent-upcast leak — someone widened a value
+  specifically to run the matmul in f32 — and is counted separately as
+  ``f32_upcast``.
+- **fp32-mandatory regions** (:func:`fp32_region_census`):
+  ``stablehlo.exponential`` (attention softmax + the CE loss's
+  logsumexp — gelu lowers to tanh, not exp, so exp IS the softmax/loss
+  fingerprint in this model family) and ``stablehlo.rsqrt`` (LayerNorm
+  variance) must be f32 under EVERY policy — a bf16 instance is a
+  dangerous downcast, not an optimization.
+- **Cast placement** (:func:`scan_convert_census`): ``stablehlo.convert``
+  ops INSIDE the layer scan's while body, with the param-cast subset
+  identified by ORIGIN, not shape: a downcast whose operand chain
+  (through reshape/transpose/broadcast) roots in a ``dynamic_slice`` of a
+  loop-carried value is the per-layer fetch of a stacked parameter being
+  cast EVERY layer — churn that hoists by storing params in the compute
+  dtype (exactly what ``bf16_mixed`` does; under it the count must be
+  zero). Shape matching is deliberately avoided: XLA sinks f32->bf16
+  converts below gathers (the PR 11 false-positive class), and
+  activation tensors can share shapes with param slices on small models.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import re
+
+#: dtype token inside a tensor type, e.g. tensor<8x32x64xbf16> -> "bf16".
+_TENSOR_DTYPE = re.compile(r"tensor<(?:[\d?]+x)*([a-z][a-z0-9]*)>")
+
+#: one SSA use/def id: %123, %iterArg_17, %arg4, %cst_9.
+_SSA_ID = re.compile(r"%[\w.]+")
+
+#: an op line: `%res[:n] = stablehlo.op ...` (also matches func args etc.;
+#: the op-name capture filters).
+_OP_LINE = re.compile(r"^\s*(%[\w.]+)(?::\d+)?\s*=\s*stablehlo\.(\w+)\b(.*)$")
+
+#: a call line: `%r:39 = func.call @None(...)` / `%r = call @_take(...)`.
+#: jax OUTLINES the layer-scan body into private functions — the while's
+#: do-region mostly slices the stacked params and calls these, so any
+#: per-layer analysis must follow call edges.
+_CALL_LINE = re.compile(
+    r"^\s*(%[\w.]+)(?::\d+)?\s*=\s*(?:func\.)?call\s+@([\w.]+)\((.*)$"
+)
+
+#: a function definition line: `func.func private @None(%arg0: ..., ...`.
+_FUNC_LINE = re.compile(r"^\s*func\.func\s+\w*\s*@([\w.]+)\(")
+
+
+@dataclasses.dataclass
+class StableOp:
+    """One parsed StableHLO instruction."""
+
+    result: str                 # SSA id of the result
+    op: str                     # op name without the stablehlo. prefix
+    operands: tuple[str, ...]   # SSA ids of the operands
+    in_dtypes: tuple[str, ...]  # dtype tokens of the operand types
+    out_dtype: str              # dtype token of the (first) result type
+    in_scan_body: bool          # inside any while op's `do` region
+    region: tuple[int, ...] = ()  # open-brace id path (SSA names are
+    #                               region-scoped: %88 in one func is not
+    #                               %88 in another)
+
+
+@dataclasses.dataclass
+class StableCall:
+    """One ``call @fn(...)`` site."""
+
+    callee: str
+    operands: tuple[str, ...]
+    in_scan_body: bool
+    region: tuple[int, ...]
+
+
+@dataclasses.dataclass
+class Program:
+    """A parsed StableHLO module: ops, the call graph, and each named
+    function's body-region path (the key that scopes its ``%argN``
+    names)."""
+
+    ops: list[StableOp]
+    calls: list[StableCall]
+    funcs: dict[str, tuple[int, ...]]  # func name -> body region path
+
+    def scan_funcs(self) -> set[str]:
+        """Functions reachable from any while ``do`` region — i.e. code
+        that runs ONCE PER LAYER (or per token, for decode's outer scan).
+        jax outlines the scan body into ``@None``-style private funcs, so
+        'inside the scan' must be computed over call edges, not just
+        syntactic nesting."""
+        by_region: list[tuple[tuple[int, ...], str]] = sorted(
+            ((reg, name) for name, reg in self.funcs.items()),
+            key=lambda t: len(t[0]), reverse=True,
+        )
+
+        def owner(region: tuple[int, ...]) -> str | None:
+            for reg, name in by_region:
+                if region[:len(reg)] == reg:
+                    return name
+            return None
+
+        reached: set[str] = set()
+        frontier = [c.callee for c in self.calls if c.in_scan_body]
+        while frontier:
+            f = frontier.pop()
+            if f in reached:
+                continue
+            reached.add(f)
+            for c in self.calls:
+                if owner(c.region) == f:
+                    frontier.append(c.callee)
+        return reached
+
+
+def _split_types(tail: str) -> tuple[tuple[str, ...], str]:
+    """(operand dtypes, result dtype) from an op line's trailing
+    ``: (types) -> type`` or ``: type`` annotation. The split is on the
+    LAST top-level `` : `` so attribute payloads that mention types (the
+    ``algorithm = <lhs_precision_type = bf16, ...>`` attribute on
+    accumulation-controlled dots) never pollute the signature."""
+    idx = tail.rfind(" : ")
+    if idx < 0:
+        return (), ""
+    sig = tail[idx + 3:]
+    if "->" in sig:
+        ins, _, outs = sig.partition("->")
+    else:
+        # Same-type elementwise shorthand: `%a = stablehlo.rsqrt %b : tensor<..>`
+        ins, outs = sig, sig
+    in_dt = tuple(_TENSOR_DTYPE.findall(ins))
+    out_m = _TENSOR_DTYPE.findall(outs)
+    return in_dt, (out_m[0] if out_m else "")
+
+
+@functools.lru_cache(maxsize=8)
+def parse_program(txt: str) -> Program:
+    """Parse every ``stablehlo.*`` instruction, call site, and function
+    definition, with scan-body membership.
+
+    lru_cached on the raw text: one audited entry's censuses + the
+    fingerprint would otherwise re-regex the same multi-MB dump 6+ times
+    (hashing the string once is noise next to one parse). Callers treat
+    the returned Program as read-only — every consumer here does.
+
+    Scan bodies are tracked syntactically: a ``stablehlo.while`` opens a
+    ``cond { ... } do { ... }`` region pair; everything inside a ``do``
+    region (nested whiles included — decode's token scan wraps the layer
+    scan) is ``in_scan_body``. Brace depth per line is enough because the
+    MLIR printer never splits a region brace across tokens. Code the scan
+    body reaches through ``call`` edges is resolved separately
+    (:meth:`Program.scan_funcs`)."""
+    ops: list[StableOp] = []
+    calls: list[StableCall] = []
+    funcs: dict[str, tuple[int, ...]] = {}
+    body_depth = 0                 # nesting count of open `do {` regions
+    # Stack of (unique id, is_do_region) per open brace; the id path is
+    # the op's region key for SSA-name scoping.
+    open_braces: list[tuple[int, bool]] = []
+    next_id = 0
+    for line in txt.splitlines():
+        m = _OP_LINE.match(line)
+        pending_func = None
+        if m:
+            result, op, tail = m.group(1), m.group(2), m.group(3)
+            # Operand ids are the SSA uses BEFORE the type annotation (the
+            # result id is already consumed by the line regex).
+            idx = tail.rfind(" : ")
+            head = tail[:idx] if idx >= 0 else tail
+            in_dt, out_dt = _split_types(tail)
+            ops.append(StableOp(
+                result=result,
+                op=op,
+                operands=tuple(_SSA_ID.findall(head)),
+                in_dtypes=in_dt,
+                out_dtype=out_dt,
+                in_scan_body=body_depth > 0,
+                region=tuple(bid for bid, _ in open_braces),
+            ))
+        else:
+            mc = _CALL_LINE.match(line)
+            if mc:
+                head = mc.group(3)
+                idx = head.rfind(" : ")
+                if idx >= 0:
+                    head = head[:idx]
+                calls.append(StableCall(
+                    callee=mc.group(2),
+                    operands=tuple(_SSA_ID.findall(head)),
+                    in_scan_body=body_depth > 0,
+                    region=tuple(bid for bid, _ in open_braces),
+                ))
+            else:
+                mf = _FUNC_LINE.match(line)
+                if mf:
+                    pending_func = mf.group(1)
+        # Brace bookkeeping, in source order, AFTER the line's op (the
+        # while's own line sits outside its regions). The MLIR printer
+        # writes `cond {` / `} do {` / `}` — so `} do {` first pops the
+        # cond brace, then pushes the body brace.
+        for tok in re.finditer(r"[{}]", line):
+            if tok.group() == "{":
+                is_do = line[:tok.start()].rstrip().endswith("do")
+                open_braces.append((next_id, is_do))
+                next_id += 1
+                if is_do:
+                    body_depth += 1
+                if pending_func is not None:
+                    # The first `{` of a func.func line opens its body.
+                    funcs[pending_func] = tuple(bid for bid, _ in open_braces)
+                    pending_func = None
+            elif open_braces:
+                if open_braces.pop()[1]:
+                    body_depth -= 1
+    return Program(ops=ops, calls=calls, funcs=funcs)
+
+
+def parse_ops(txt: str) -> list[StableOp]:
+    """All parsed instructions (see :func:`parse_program`)."""
+    return parse_program(txt).ops
+
+
+def _def_map(ops: list[StableOp]) -> dict[tuple, StableOp]:
+    """(region path, result id) -> defining op. SSA value names are
+    REGION-scoped in MLIR text (`%88` in the main func and `%88` inside a
+    private backward func are different values), so lookups must walk the
+    use site's region path from innermost outward — :func:`_lookup`."""
+    return {(o.region, o.result): o for o in ops}
+
+
+def _lookup(defs: dict, user: StableOp, operand: str) -> StableOp | None:
+    """Resolve an operand id visible at ``user``'s region path: innermost
+    scope first, then each enclosing region. Region-boundary names with
+    no def anywhere (`%arg*` block args, `%iterArg*` loop carries) return
+    None — which is exactly what the origin walks key on."""
+    for k in range(len(user.region), -1, -1):
+        d = defs.get((user.region[:k], operand))
+        if d is not None:
+            return d
+    return None
+
+
+#: ops the origin walk for casts looks THROUGH (layout/shape plumbing).
+_TRANSPARENT = ("reshape", "transpose", "broadcast_in_dim", "convert")
+
+
+def dot_signature_census(txt: str) -> dict[str, int]:
+    """Counts of ``dot_general`` ops by operand-dtype signature:
+
+    - ``bf16_bf16``: both operands bf16 (result may be bf16 or an f32
+      accumulation — both are the bf16 region).
+    - ``bf16_mixed``: exactly one bf16 operand.
+    - ``f32_f32``: both operands natively f32 (legitimate inside the
+      fp32-mandated softmax/loss neighborhood).
+    - ``f32_transpose``: exactly ONE operand is a direct bf16->f32
+      ``convert``, the other natively f32 — the autodiff transpose of an
+      f32-accumulating bf16 dot (the f32 cotangent of the score dot
+      contracts against an upcast of the bf16 primal; jax widens the
+      primal so dq/dk accumulate in f32 before downcasting). Benign —
+      desirable, even — and baseline-pinned so a count change surfaces.
+    - ``f32_upcast``: BOTH operands are direct bf16->f32 converts — the
+      cast-then-dot leak (a value pair widened specifically to run the
+      matmul in f32; no accumulation argument applies when both sides
+      were bf16 to begin with).
+    - ``other``: anything else (int dots, f64 — the f64 rule catches
+      those separately).
+    """
+    ops = parse_ops(txt)
+    defs = _def_map(ops)
+    out = {"bf16_bf16": 0, "bf16_mixed": 0, "f32_f32": 0,
+           "f32_transpose": 0, "f32_upcast": 0, "other": 0}
+    for o in ops:
+        if o.op != "dot_general":
+            continue
+        dts = o.in_dtypes[:2]
+        n_bf16 = sum(1 for d in dts if d == "bf16")
+        if n_bf16 == 2:
+            out["bf16_bf16"] += 1
+        elif n_bf16 == 1:
+            out["bf16_mixed"] += 1
+        elif tuple(dts) == ("f32", "f32"):
+            upcasts = 0
+            for operand in o.operands[:2]:
+                d = _lookup(defs, o, operand)
+                if d is not None and d.op == "convert" and (
+                    d.in_dtypes[:1] == ("bf16",) and d.out_dtype == "f32"
+                ):
+                    upcasts += 1
+            key = {0: "f32_f32", 1: "f32_transpose", 2: "f32_upcast"}[upcasts]
+            out[key] += 1
+        else:
+            out["other"] += 1
+    return out
+
+
+#: fp32-mandatory op set: softmax/logsumexp exponentials, LN-variance
+#: rsqrt. (sqrt is NOT in the set — AdamW's denominator sqrt is f32 by
+#: the optimizer-state rule, and grad-clip's norm sqrt follows the grad
+#: dtype by design.)
+FP32_MANDATORY_OPS = ("exponential", "rsqrt")
+
+
+def fp32_region_census(txt: str) -> dict[str, dict[str, int]]:
+    """Result-dtype counts of the fp32-mandatory ops, e.g.
+    ``{"exponential": {"f32": 3}, "rsqrt": {"f32": 3}}`` — a bf16 key
+    appearing under either op is a dangerous downcast (rules.py errors)."""
+    out: dict[str, dict[str, int]] = {op: {} for op in FP32_MANDATORY_OPS}
+    for o in parse_ops(txt):
+        if o.op in out:
+            row = out[o.op]
+            row[o.out_dtype] = row.get(o.out_dtype, 0) + 1
+    return out
+
+
+def _origin(defs: dict, op: StableOp, operand: str) -> tuple[StableOp | None, str | None]:
+    """Walk ``operand`` back through shape plumbing
+    (reshape/transpose/broadcast/convert); return (last defining op seen,
+    final root id). A root with no def is a region-boundary value (block
+    arg / loop carry)."""
+    last: StableOp | None = None
+    cur_op: StableOp | None = op
+    cur: str | None = operand
+    for _ in range(8):  # bounded walk; chains are short
+        d = _lookup(defs, cur_op, cur) if (cur and cur_op) else None
+        if d is None:
+            break
+        last = d
+        if d.op in _TRANSPARENT or d.op == "dynamic_slice":
+            cur_op = d
+            cur = d.operands[0] if d.operands else None
+            if d.op == "dynamic_slice":
+                break
+            continue
+        break
+    return last, cur
+
+
+def scan_convert_census(txt: str) -> dict[str, int]:
+    """Convert ops that run ONCE PER LAYER — inside a while (scan) body,
+    or inside a function the scan body calls (jax outlines the per-layer
+    Block computation into ``@None``-style private funcs) — by direction,
+    plus the param-cast churn subset:
+
+    - ``f32_to_bf16`` / ``bf16_to_f32``: all per-layer converts by
+      direction (the LN/softmax island boundaries legitimately cast every
+      layer — these counts are baseline-pinned context, not findings).
+    - ``param_slice_downcast``: f32->bf16 converts of a PER-LAYER
+      PARAMETER SLICE — identified by origin, not shape: either the
+      convert's operand chain roots in a ``dynamic_slice`` of a
+      loop-carried value (the stacked-param fetch, inline form), or the
+      convert sits in a scan-called function and its operand chain roots
+      in a block arg whose CALL-SITE operand is such a slice. This is the
+      cast churn the lint exists for: the same parameter bytes re-cast L
+      times per step instead of once; storing params in the compute dtype
+      (``bf16_mixed``) removes the cast entirely, which is why the count
+      must be ZERO under that policy.
+    """
+    prog = parse_program(txt)
+    defs = _def_map(prog.ops)
+    scan_funcs = prog.scan_funcs()
+    func_regions = {reg: name for name, reg in prog.funcs.items()}
+
+    def in_scan(region: tuple[int, ...], syntactic: bool) -> bool:
+        if syntactic:
+            return True
+        for k in range(len(region), 0, -1):
+            name = func_regions.get(region[:k])
+            if name is not None:
+                return name in scan_funcs
+        return False
+
+    # Per scan-body call site: the set of arg positions fed by a
+    # dynamic_slice of a loop carry (the per-layer param fetch). Unioned
+    # per callee — good enough, since healthy activations never alias a
+    # param position.
+    slice_args: dict[str, set[int]] = {}
+    for c in prog.calls:
+        if not c.in_scan_body:
+            continue
+        fake = StableOp("%_", "call", c.operands, (), "", True, c.region)
+        for i, operand in enumerate(c.operands):
+            last, root = _origin(defs, fake, operand)
+            if (
+                last is not None and last.op == "dynamic_slice"
+                and root is not None
+                and _lookup(defs, last, root) is None
+            ):
+                slice_args.setdefault(c.callee, set()).add(i)
+
+    out = {"f32_to_bf16": 0, "bf16_to_f32": 0, "param_slice_downcast": 0}
+    for o in prog.ops:
+        if o.op != "convert" or not in_scan(o.region, o.in_scan_body):
+            continue
+        src = o.in_dtypes[0] if o.in_dtypes else ""
+        dst = o.out_dtype
+        if (src, dst) == ("bf16", "f32"):
+            out["bf16_to_f32"] += 1
+            continue
+        if (src, dst) != ("f32", "bf16"):
+            continue
+        out["f32_to_bf16"] += 1
+        last, root = _origin(defs, o, o.operands[0] if o.operands else None)
+        if last is not None and last.op == "dynamic_slice" and (
+            root is not None and _lookup(defs, last, root) is None
+        ):
+            # Inline form: slice-of-carry converted in the body itself.
+            out["param_slice_downcast"] += 1
+            continue
+        if root is None or not re.fullmatch(r"%arg\d+", root or ""):
+            continue
+        # Outlined form: the convert's root is a block arg of the func it
+        # lives in; flag when the call site feeds that position a
+        # slice-of-carry.
+        owner = None
+        for k in range(len(o.region), 0, -1):
+            name = func_regions.get(o.region[:k])
+            if name is not None:
+                owner = name
+                break
+        if owner in slice_args and int(root[4:]) in slice_args[owner]:
+            out["param_slice_downcast"] += 1
+    return out
+
+
+def numerics_fingerprint(
+    stablehlo_text: str,
+    *,
+    precision: str = "fp32",
+    loss_dtype: str = "",
+    state_dtypes: dict[str, list[str]] | None = None,
+    collective_dtypes: dict[str, dict[str, int]] | None = None,
+) -> dict:
+    """The drift-gated numerics summary of one entry (report.py commits
+    it as ``<entry>.numerics.json``). Everything in here is deterministic
+    graph structure — counts, not timings."""
+    return {
+        "precision": precision,
+        "dots": dot_signature_census(stablehlo_text),
+        "fp32_regions": fp32_region_census(stablehlo_text),
+        "scan_converts": scan_convert_census(stablehlo_text),
+        "loss_dtype": loss_dtype,
+        "state_dtypes": state_dtypes or {},
+        "collective_dtypes": collective_dtypes or {},
+    }

@@ -22,11 +22,19 @@ import json
 import os
 from typing import Any, Iterable
 
-from dtc_tpu.analysis import hlo
+from dtc_tpu.analysis import hlo, memory, numerics
 from dtc_tpu.analysis.lowering import Artifact
 from dtc_tpu.analysis.rules import Finding
 
 BASELINE_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "baselines")
+
+#: ISSUE-14 baseline sections: each audited entry additionally commits a
+#: ``<entry>.numerics.json`` (dtype-flow fingerprint) and a
+#: ``<entry>.memory.json`` (static HBM plan). Separate FILES on purpose:
+#: the pre-existing ``<entry>.json`` graph fingerprints stay
+#: byte-identical — the new families extend the gate without re-blessing
+#: eleven committed baselines whose graphs did not change.
+SECTIONS = ("numerics", "memory")
 
 
 def artifact_fingerprint(a: Artifact) -> dict[str, Any]:
@@ -51,20 +59,47 @@ def artifact_fingerprint(a: Artifact) -> dict[str, Any]:
     }
 
 
+def numerics_fingerprint(a: Artifact) -> dict[str, Any]:
+    """The dtype-flow invariants of one entry (ISSUE 14) — committed as
+    ``<entry>.numerics.json``."""
+    return numerics.numerics_fingerprint(
+        a.stablehlo_text,
+        precision=a.precision,
+        loss_dtype=a.loss_dtype,
+        state_dtypes=a.state_dtypes,
+        collective_dtypes=hlo.collective_dtype_census(a.hlo_text),
+    )
+
+
+def memory_fingerprint(a: Artifact) -> dict[str, Any]:
+    """The static HBM plan of one entry (ISSUE 14) — committed as
+    ``<entry>.memory.json``. None for artifacts without the byte
+    evidence (state_bytes unrecorded)."""
+    if not a.state_bytes:
+        return {}
+    return memory.hbm_plan(a)
+
+
 def build_report(
-    artifacts: Iterable[Artifact], findings: Iterable[Finding]
+    artifacts: Iterable[Artifact],
+    findings: Iterable[Finding],
+    *,
+    sections: tuple[str, ...] = SECTIONS,
 ) -> dict[str, Any]:
-    """Assemble the serializable audit report: per-entry fingerprints plus
-    severity-ranked findings (per-artifact and source-level alike)."""
+    """Assemble the serializable audit report: per-entry fingerprints
+    (graph + the ISSUE-14 numerics/memory sections) plus severity-ranked
+    findings (per-artifact and source-level alike). ``sections`` narrows
+    the extra sections (audit_graph.py's --no-numerics/--no-memory)."""
     import jax
 
+    artifacts = list(artifacts)
     findings = sorted(
         findings, key=lambda f: ("error", "warn", "info").index(f.severity)
     )
     by_sev: dict[str, int] = {}
     for f in findings:
         by_sev[f.severity] = by_sev.get(f.severity, 0) + 1
-    return {
+    report = {
         "jax": jax.__version__,
         "platform": jax.devices()[0].platform,
         "device_count": jax.device_count(),
@@ -72,17 +107,29 @@ def build_report(
         "findings": [f.as_dict() for f in findings],
         "summary": by_sev,
     }
+    if "numerics" in sections:
+        report["numerics"] = {
+            a.name: numerics_fingerprint(a) for a in artifacts
+        }
+    if "memory" in sections:
+        report["memory"] = {
+            a.name: fp for a in artifacts
+            if (fp := memory_fingerprint(a))
+        }
+    return report
 
 
-def _baseline_path(name: str, directory: str) -> str:
-    return os.path.join(directory, f"{name}.json")
+def _baseline_path(name: str, directory: str, section: str = "") -> str:
+    suffix = f".{section}" if section else ""
+    return os.path.join(directory, f"{name}{suffix}.json")
 
 
 def write_baselines(
     report: dict[str, Any], directory: str = BASELINE_DIR
 ) -> list[str]:
     """Bless the report's fingerprints as the committed baselines (one
-    file per entry, so a drift diff names the entry in `git status`)."""
+    file per entry — plus one per ISSUE-14 section present in the report
+    — so a drift diff names the entry AND the family in `git status`)."""
     os.makedirs(directory, exist_ok=True)
     written = []
     for name, fp in report["entries"].items():
@@ -95,6 +142,17 @@ def write_baselines(
             )
             f.write("\n")
         written.append(path)
+    for section in SECTIONS:
+        for name, fp in report.get(section, {}).items():
+            path = _baseline_path(name, directory, section)
+            with open(path, "w") as f:
+                json.dump(
+                    {"jax": report["jax"], "platform": report["platform"],
+                     "fingerprint": fp},
+                    f, indent=1, sort_keys=True,
+                )
+                f.write("\n")
+            written.append(path)
     return written
 
 
@@ -128,11 +186,21 @@ def check_baselines(
     the baseline was blessed under a different jax version (warn: the
     graph legitimately moves across XLA releases)."""
     out: list[Finding] = []
-    for name, fp in report["entries"].items():
-        path = _baseline_path(name, directory)
+    checks: list[tuple[str, str, dict]] = [
+        ("", name, fp) for name, fp in report["entries"].items()
+    ]
+    for section in SECTIONS:
+        checks.extend(
+            (section, name, fp)
+            for name, fp in report.get(section, {}).items()
+        )
+    for section, name, fp in checks:
+        label = f"{name}.{section}" if section else name
+        rule_kind = f"{section} fingerprint" if section else "graph"
+        path = _baseline_path(name, directory, section)
         if not os.path.exists(path):
             out.append(Finding(
-                "baseline.missing", "error" if require else "warn", name,
+                "baseline.missing", "error" if require else "warn", label,
                 f"no committed baseline at {path} — bless the current graph "
                 "with scripts/audit_graph.py --write-baseline",
             ))
@@ -152,8 +220,9 @@ def check_baselines(
             f"{report['platform']} — drift downgraded to warn]"
         )
         out.append(Finding(
-            "baseline.drift", sev, name,
-            f"graph drifted from committed baseline ({len(lines)} field(s))"
+            "baseline.drift", sev, label,
+            f"{rule_kind} drifted from committed baseline "
+            f"({len(lines)} field(s))"
             f"{env_note}:\n    " + "\n    ".join(lines)
             + "\n  re-bless with scripts/audit_graph.py --write-baseline "
             "if intended",

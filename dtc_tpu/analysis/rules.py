@@ -28,13 +28,30 @@ round-5 one-off tests) depend on:
    loop outside sanctioned boundaries (see :mod:`hostsync`).
 5. **recompile fingerprint** — a compiled entry point executes from ONE
    executable: cold exactly one backend compile, steady zero.
+
+Three ISSUE-14 families extend the set:
+
+6. **numerics / dtype-flow** — the declared precision policy actually
+   lowered (bf16 matmuls under ``bf16_mixed``, no cast-then-dot upcast
+   leaks, no per-layer param-cast churn in the scan body) and the
+   fp32-mandatory islands (softmax/LN-variance exp+rsqrt, the loss
+   value, fp32 AdamW moments and master weights, no bf16 collectives
+   under an fp32 policy) never downcast — see :mod:`numerics`.
+7. **static memory plan** — the per-entry HBM byte decomposition
+   (params / masters / moments / activations / comm buffers) reproduces
+   the compiled module's entry layout, the bf16_mixed plan contains the
+   masters + bf16 params it promises, and the total sits in a warn-band
+   of ``utils/metrics.train_memory_bytes`` — see :mod:`memory`.
+8. **dtype-literal lint** — no hard-coded ``jnp.float32``-style literals
+   in model/op hot paths outside the sanctioned mandated-precision
+   scopes — see :mod:`dtypelint`.
 """
 
 from __future__ import annotations
 
 import dataclasses
 
-from dtc_tpu.analysis import hlo
+from dtc_tpu.analysis import dtypelint, hlo, memory, numerics
 from dtc_tpu.analysis.hostsync import lint_file, unsanctioned
 from dtc_tpu.analysis.lowering import Artifact
 
@@ -66,6 +83,10 @@ REQUIRED_COLLECTIVES: dict[str, tuple[str, ...]] = {
     # all-reduces (the explicit psums); its ring transport is checked
     # separately below.
     "train_3d": ("all-reduce",),
+    # bf16_mixed rides the same dp mesh as train_dp: the (bf16) gradient
+    # all-reduce must still be there — losing it under the new precision
+    # mode would be the replicated-fallback class with a dtype twist.
+    "train_bf16": ("all-reduce",),
 }
 
 #: ISSUE 12 entries whose FSDP traffic rides the overlap ring: the
@@ -338,6 +359,195 @@ def audit_dtypes(a: Artifact) -> list[Finding]:
     return out
 
 
+# -- family 6: numerics / dtype-flow audit (ISSUE 14) ----------------------
+
+#: Memory-plan vs analytic-model cross-check band (ratio) — same wide-band
+#: philosophy as the census bytes check: the analytic model is structural
+#: (XLA fuses/reuses buffers), the band catches 100x accounting bugs, and
+#: the committed baselines pin the exact numbers.
+MEMORY_CROSS_CHECK_BAND = (1 / 8, 8.0)
+
+#: Entry-layout decomposition slack: the classified state + batch bytes
+#: must reproduce the module's entry-parameter bytes within this
+#: fraction (plus a small constant for stray scalars the classifier
+#: lumps differently than the layout pads them).
+ENTRY_DECOMP_TOL = 0.02
+ENTRY_DECOMP_SLACK_BYTES = 4096
+
+
+def audit_numerics(a: Artifact) -> list[Finding]:
+    """Dtype-flow rules over the StableHLO lowering (ISSUE 14): the
+    declared precision policy must have ACTUALLY lowered — bf16 matmuls
+    under ``bf16_mixed`` with no cast-then-dot leaks and no per-layer
+    param-cast churn — and the fp32-mandatory islands (softmax/LN
+    variance via exp/rsqrt, the loss value, fp32 optimizer moments and
+    masters) must stay fp32 under EVERY policy."""
+    out: list[Finding] = []
+    dots = numerics.dot_signature_census(a.stablehlo_text)
+    regions = numerics.fp32_region_census(a.stablehlo_text)
+    converts = numerics.scan_convert_census(a.stablehlo_text)
+
+    if a.precision == "bf16_mixed":
+        bf16_dots = dots["bf16_bf16"] + dots["bf16_mixed"]
+        if bf16_dots == 0:
+            out.append(_err(
+                "numerics.matmul_region", a.name,
+                "policy declares bf16_mixed but ZERO matmuls lowered with "
+                f"a bf16 operand ({dots}) — the policy did not reach the "
+                "model (params/compute still fp32?)",
+            ))
+        if converts["param_slice_downcast"]:
+            out.append(_err(
+                "numerics.cast_churn", a.name,
+                f"{converts['param_slice_downcast']} per-layer param-slice "
+                "downcast(s) inside the layer scan under bf16_mixed — "
+                "params should already be STORED bf16 (the whole point); "
+                "a scan-body cast means fp32 params leaked through",
+            ))
+    elif converts["param_slice_downcast"]:
+        # fp32-policy models with a bf16 compute dtype pay this cast L
+        # times per step (the flagship default before bf16_mixed) — warn,
+        # with the fix named; the baseline pins the count either way.
+        out.append(_warn(
+            "numerics.cast_churn", a.name,
+            f"{converts['param_slice_downcast']} per-layer param-slice "
+            "downcast(s) inside the layer scan: fp32-stored params are "
+            "re-cast to the compute dtype EVERY layer, every step — "
+            "precision: bf16_mixed stores bf16 params and hoists the "
+            "cast out of the step entirely",
+        ))
+    if dots["f32_upcast"]:
+        out.append(_err(
+            "numerics.upcast_leak", a.name,
+            f"{dots['f32_upcast']} matmul(s) run on f32 UPCASTS of bf16 "
+            "values (both operands cast-then-dot) — compute the dot in "
+            "bf16 with preferred_element_type=f32 if f32 accumulation "
+            "was the goal",
+        ))
+    for op, row in regions.items():
+        low = {dt: n for dt, n in row.items() if dt in ("bf16", "f16")}
+        if low:
+            out.append(_err(
+                "numerics.fp32_mandatory", a.name,
+                f"{op} lowered in reduced precision {low} — softmax/LN "
+                "variance are fp32-mandatory under every policy "
+                "(dangerous downcast)",
+            ))
+    if a.kind == "train" and a.loss_dtype and a.loss_dtype != "f32":
+        out.append(_err(
+            "numerics.loss_dtype", a.name,
+            f"loss output is {a.loss_dtype}, not f32 — the CE/logsumexp "
+            "reduction is fp32-mandatory",
+        ))
+    sd = a.state_dtypes or {}
+    if sd.get("opt_moments") and sd["opt_moments"] != ["f32"]:
+        out.append(_err(
+            "numerics.optimizer_state", a.name,
+            f"AdamW moments hold {sd['opt_moments']} — moment "
+            "accumulation is fp32-mandatory under every policy",
+        ))
+    if a.precision == "bf16_mixed":
+        if sd.get("opt_master", []) != ["f32"]:
+            out.append(_err(
+                "numerics.optimizer_state", a.name,
+                f"bf16_mixed master weights hold {sd.get('opt_master')} "
+                "— masters must be exactly fp32 (with_master_weights)",
+            ))
+    elif a.kind == "train" and a.precision == "fp32":
+        cd = hlo.collective_dtype_census(a.hlo_text)
+        bf16_colls = {
+            op: row["bf16"] for op, row in cd.items() if row.get("bf16")
+        }
+        if bf16_colls:
+            out.append(_err(
+                "numerics.grad_accum_downcast", a.name,
+                f"fp32 policy but bf16 collective(s) on the wire "
+                f"{bf16_colls} — cross-replica gradient accumulation "
+                "silently downcast",
+            ))
+    return out
+
+
+# -- family 7: static memory plan (ISSUE 14) -------------------------------
+
+def audit_memory(a: Artifact) -> list[Finding]:
+    """Static-HBM-plan rules: the state-byte decomposition must reproduce
+    the compiled module's entry-layout bytes (the proof the plan
+    describes THIS program), the bf16_mixed plan must actually contain
+    the fp32 masters + halved bf16 params it promises, and the plan
+    total must sit in a wide warn-band of the analytic model."""
+    out: list[Finding] = []
+    if not a.state_bytes:
+        return out
+    plan = memory.hbm_plan(a)
+    known = int(sum(a.state_bytes.values())) + int(a.batch_bytes or 0)
+    ins = plan["entry_inputs"]
+    if ins and abs(ins - known) > (
+        ENTRY_DECOMP_TOL * ins + ENTRY_DECOMP_SLACK_BYTES
+    ):
+        out.append(_err(
+            "memory.entry_decomposition", a.name,
+            f"classified state+batch bytes {known} do not reproduce the "
+            f"module's entry-parameter bytes {ins} — the params/master/"
+            "moments split has rotted away from the program it claims to "
+            "describe",
+        ))
+    if a.precision == "bf16_mixed":
+        params = plan.get("params", 0)
+        master = plan.get("opt_master", 0)
+        if master == 0:
+            out.append(_err(
+                "memory.master_weights", a.name,
+                "bf16_mixed declared but the state holds NO master-weight "
+                "bytes — the optimizer is not running the fp32-master "
+                "schedule (told bf16_mixed over an fp32 program?)",
+            ))
+        elif not master // 2 <= params <= master:
+            # bf16 params are exactly half their fp32 masters, except the
+            # always-fp32 LN leaves (master == params for those) — so
+            # params must land in [master/2, master], both ends inclusive
+            # (all-bf16 tree at the low end, degenerate all-fp32-island
+            # tree at the high end).
+            out.append(_err(
+                "memory.master_weights", a.name,
+                f"bf16_mixed param bytes {params} vs master bytes "
+                f"{master}: expected params in [master/2, master] (bf16 "
+                "payload + fp32 LN islands) — the param tree is not "
+                "actually stored bf16",
+            ))
+    est = a.mem_estimate or {}
+    if est.get("total"):
+        lo, hi = MEMORY_CROSS_CHECK_BAND
+        ratio = plan["total"] / est["total"]
+        if not (lo <= ratio <= hi):
+            out.append(_warn(
+                "memory.bytes_cross_check", a.name,
+                f"static plan total {plan['total']:.3e} vs analytic "
+                f"train_memory_bytes {est['total']:.3e} (ratio {ratio:.2f} "
+                f"outside [{lo:.3f}, {hi:.1f}])",
+            ))
+    return out
+
+
+# -- family 8: dtype-literal source lint (ISSUE 14) ------------------------
+
+def audit_dtype_literals() -> list[Finding]:
+    """Source-level twin of the host-sync lint: hard-coded dtype literals
+    in ``models/``/``ops/`` hot paths outside the sanctioned
+    mandated-precision scopes (see :mod:`dtc_tpu.analysis.dtypelint`).
+    One finding list for the tree, like :func:`audit_hostsync`."""
+    return [
+        _err(
+            "dtypelint.hardcoded", "tree",
+            f"{s.rel}:{s.lineno}: {s.code} in "
+            f"{'/'.join(s.scope) or '<module>'} bypasses the precision "
+            "policy (not in dtypelint.ALLOWLIST; if this is a new "
+            "mandated-fp32 region, allowlist it WITH its justification)",
+        )
+        for s in dtypelint.unsanctioned(dtypelint.lint_tree())
+    ]
+
+
 # -- family 4: host-sync lint ---------------------------------------------
 
 def audit_hostsync(path: str | None = None) -> list[Finding]:
@@ -375,10 +585,21 @@ def audit_recompile(a: Artifact) -> list[Finding]:
     return out
 
 
-def audit_artifact(a: Artifact) -> list[Finding]:
-    """All per-artifact rule families (1-3, 5; the source lint in family 4
-    is per-file — see :func:`audit_hostsync`)."""
-    return (
+def audit_artifact(
+    a: Artifact, *, numerics: bool = True, memory: bool = True
+) -> list[Finding]:
+    """All per-artifact rule families (1-3, 5-7; the source lints in
+    families 4 and 8 are per-file/tree — see :func:`audit_hostsync` and
+    :func:`audit_dtype_literals`). ``numerics``/``memory`` disable the
+    ISSUE-14 families — the audit_graph.py --no-numerics/--no-memory
+    escape hatches must actually bypass the passes, not just their
+    baselines."""
+    out = (
         audit_census(a) + audit_donation(a) + audit_dtypes(a)
         + audit_recompile(a)
     )
+    if numerics:
+        out += audit_numerics(a)
+    if memory:
+        out += audit_memory(a)
+    return out

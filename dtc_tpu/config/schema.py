@@ -325,10 +325,36 @@ class OptimConfig:
     schedule: str = "constant"  # constant | warmup_cosine
     warmup_steps: int = 0
     min_lr_ratio: float = 0.1
+    # Training precision policy (ISSUE 14 / ROADMAP item 3):
+    # - "fp32": everything float32 (the legacy/default state — params,
+    #   grads, moments all 4 bytes/param).
+    # - "bf16_mixed": Micikevicius-style mixed precision — the MODEL holds
+    #   bf16 params and bf16 matmuls (train_step.resolve_precision lifts
+    #   param_dtype/compute_dtype onto the model config, exactly like the
+    #   collectives knob), gradients come out of backward in bf16 (they
+    #   ride the DP/FSDP wire at 2 bytes/param), and the OPTIMIZER keeps
+    #   fp32 master weights + fp32 AdamW moments via the
+    #   train/optimizer.with_master_weights cast wrapper. fp32-mandatory
+    #   islands (softmax, LN variance, the CE loss/logsumexp) stay fp32
+    #   inside the model regardless — the graph auditor's numerics pass
+    #   (dtc_tpu/analysis/numerics.py) certifies both directions: matmuls
+    #   actually lowered bf16, mandated regions never downcast.
+    #   State bytes/param: 2 (params) + 4 (master) + 8 (moments) = 14 vs
+    #   fp32's 12 — the +2 master tax buys halved param/grad traffic on
+    #   every fwd+bwd pass and halved bf16 activations
+    #   (utils/metrics.train_memory_bytes models both; the audit's static
+    #   HBM plan cross-checks it).
+    precision: str = "fp32"
 
     def __post_init__(self) -> None:
         if self.schedule not in ("constant", "warmup_cosine"):
             raise ValueError(f"unknown schedule {self.schedule!r}")
+        if self.precision not in ("fp32", "bf16_mixed"):
+            raise ValueError(
+                f"unknown precision {self.precision!r}; expected 'fp32' "
+                "(all-float32 state) or 'bf16_mixed' (bf16 params/compute "
+                "+ fp32 master weights and moments)"
+            )
 
 
 @dataclass(frozen=True)

@@ -21,8 +21,18 @@ TPU-native differences:
   axis names via ``nn.with_logical_constraint``; the active rule table +
   mesh shape decide physical sharding (cf. reference's per-strategy branches
   at `/root/reference/model/CausalSelfAttention.py:28-31,49-50`).
-- Mixed precision: fp32 master params, bf16 (MXU-native) matmuls, fp32
-  LayerNorm/softmax/loss.
+- Mixed precision: the storage/compute pair flows from config
+  (``param_dtype``/``compute_dtype``; the default flagship pairing is fp32
+  params + bf16 MXU-native matmuls, and ``OptimConfig.precision:
+  bf16_mixed`` lifts BOTH to bf16 with fp32 master weights held by the
+  optimizer — train/train_step.resolve_precision, ISSUE 14). The
+  fp32-MANDATED islands are hard-coded by design and stay fp32 under every
+  policy: LayerNorm (``ln``/``GPTHead``), MoE routing softmax
+  (``MoEMLP``), and the CE loss (ops/fused_ce.py). Those scope names are a
+  CONTRACT with the graph auditor: analysis/dtypelint.py allowlists
+  exactly them (renaming one fails tests/test_numerics.py), and
+  analysis/numerics.py asserts the islands' exp/rsqrt lower fp32 in every
+  audited program.
 - Attention is a pluggable op (dense / Pallas flash / ring); causality lives
   inside the op — no (1,1,T,T) mask tensor threaded through the model
   (cf. `/root/reference/model/GPTModel.py:50-51`).

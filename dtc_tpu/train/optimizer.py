@@ -3,14 +3,89 @@
 Reference parity: ``clip_by_global_norm(grad_clip)`` chained into AdamW
 (`/root/reference/train/create_optimizer.py:8-12`), constant LR by default.
 Adds an optional linear-warmup + cosine-decay schedule (the reference has
-none), which longer TPU runs want.
+none), which longer TPU runs want, and the ``bf16_mixed`` master-weight
+wrapper (ISSUE 14): bf16 params in the model, fp32 masters + fp32 AdamW
+moments in the optimizer state.
 """
 
 from __future__ import annotations
 
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
 import optax
 
 from dtc_tpu.config.schema import OptimConfig
+
+
+class MasterWeightsState(NamedTuple):
+    """Optimizer state of :func:`with_master_weights`: the fp32 master
+    copy of every (bf16) parameter, plus the wrapped transformation's own
+    state built OVER those masters (so AdamW's moments are fp32 and its
+    weight decay reads full-precision weights)."""
+
+    master: Any
+    inner: Any
+
+
+def with_master_weights(
+    inner: optax.GradientTransformation,
+) -> optax.GradientTransformation:
+    """Mixed-precision master-weight wrapper (Micikevicius et al. 2018).
+
+    The model holds bf16 params; this wrapper holds the fp32 truth:
+
+    - ``init`` upcasts the params once into fp32 masters and initializes
+      ``inner`` (clip + AdamW) over the masters — moments are therefore
+      fp32 and sharded exactly like the masters (astype/zeros_like follow
+      input sharding, so FSDP shards the masters too).
+    - ``update`` upcasts the incoming (bf16) gradients to fp32, runs the
+      WHOLE inner chain in fp32 against the masters, applies the step to
+      the masters, and emits the low-precision delta
+      ``master.astype(bf16) - params`` — so ``optax.apply_updates`` /
+      ``TrainState.apply_gradients`` lands the bf16 params at exactly the
+      rounded master value (Sterbenz: the subtract of two nearby bf16
+      values is exact, and adding the delta back reproduces the rounded
+      master bit-for-bit), while tiny updates that would vanish in a bf16
+      accumulate keep accumulating in the fp32 master.
+
+    Gradients stay bf16 ON THE WIRE (the cross-replica all-reduce /
+    reduce-scatter happens where XLA puts it — at the backward's sharding
+    boundary, before this transform runs); the fp32-mandatory accumulation
+    this wrapper guarantees is the optimizer's (moments + master update).
+    The loss-parity gate in tests/test_bf16.py is the guard on the bf16
+    wire choice.
+    """
+
+    def _to_master(p):
+        # Force a DISTINCT buffer even for leaves that are already fp32
+        # (the model's LN params stay fp32 under bf16_mixed, and eager
+        # astype on a matching dtype returns the SAME array object —
+        # donating the state would then donate one buffer twice and XLA
+        # rejects the execute).
+        m = p.astype(jnp.float32)
+        return jnp.copy(m) if m is p else m
+
+    def init(params):
+        master = jax.tree.map(_to_master, params)
+        return MasterWeightsState(master=master, inner=inner.init(master))
+
+    def update(updates, state, params=None):
+        if params is None:
+            raise ValueError(
+                "with_master_weights needs the current params (the bf16 "
+                "leaves) to emit the applied delta"
+            )
+        up32 = jax.tree.map(lambda g: g.astype(jnp.float32), updates)
+        inner_up, inner_state = inner.update(up32, state.inner, state.master)
+        master = optax.apply_updates(state.master, inner_up)
+        applied = jax.tree.map(
+            lambda m, p: m.astype(p.dtype) - p, master, params
+        )
+        return applied, MasterWeightsState(master=master, inner=inner_state)
+
+    return optax.GradientTransformation(init, update)
 
 
 def create_optimizer(
@@ -25,7 +100,13 @@ def create_optimizer(
     params and optimizer state untouched — the anomaly guard's cheapest
     policy rung, applied device-side with no extra host sync. NOTE: the
     wrapper changes the optimizer-state pytree, so checkpoints do not carry
-    across toggling it (resilience.guard.skip_nonfinite_updates)."""
+    across toggling it (resilience.guard.skip_nonfinite_updates).
+
+    ``cfg.precision == "bf16_mixed"`` wraps the clip+AdamW chain in
+    :func:`with_master_weights` (INSIDE apply_if_finite, so a skipped
+    non-finite step leaves masters and moments untouched too). The
+    optimizer-state pytree changes here as well — fp32/bf16_mixed
+    checkpoints do not interconvert."""
     if cfg.schedule == "constant":
         lr = cfg.lr
     elif cfg.schedule == "warmup_cosine":
@@ -49,6 +130,11 @@ def create_optimizer(
         clip,
         optax.adamw(learning_rate=lr, b1=cfg.b1, b2=cfg.b2, weight_decay=cfg.weight_decay),
     )
+    if cfg.precision == "bf16_mixed":
+        # The whole chain (global-norm clip included) runs fp32 against
+        # the masters: clipping bf16 grads and THEN upcasting would lose
+        # the small-norm tail the fp32 moments exist to keep.
+        tx = with_master_weights(tx)
     if skip_nonfinite:
         tx = optax.apply_if_finite(tx, max_consecutive_errors=max_consecutive_skips)
     return tx

@@ -89,6 +89,46 @@ def canonicalize_state_placement(state: TrainState, mesh: Mesh) -> TrainState:
     return jax.tree.map(leaf, state)
 
 
+def resolve_precision(opt_cfg, model_cfg):
+    """Route ``OptimConfig.precision`` onto the model config — the exact
+    pattern of :func:`resolve_collectives`, so every train-step consumer
+    (trainer, bench, audit lowering) resolves the policy through ONE
+    definition and the lowered-and-audited program cannot diverge from the
+    trained one.
+
+    - ``fp32`` (default): the model config passes through untouched —
+      every existing program is byte-identical.
+    - ``bf16_mixed``: the model stores bf16 params and runs bf16 matmuls
+      (``param_dtype``/``compute_dtype`` both lifted to ``bfloat16``);
+      the fp32 master weights + fp32 AdamW moments live in the optimizer
+      (``train/optimizer.with_master_weights`` — create_optimizer reads
+      the same knob). The model's fp32-mandatory islands (softmax, LN
+      variance, CE loss) are fp32 by construction in models/gpt.py and
+      certified by the graph auditor's numerics pass. float16 configs are
+      rejected: fp16 needs loss scaling this repo does not implement, and
+      silently training fp16 under a knob named bf16_mixed would be worse
+      than an error.
+    """
+    import dataclasses
+
+    if getattr(opt_cfg, "precision", "fp32") != "bf16_mixed":
+        return model_cfg
+    if "float16" in (model_cfg.param_dtype, model_cfg.compute_dtype):
+        raise ValueError(
+            "precision: bf16_mixed cannot combine with a float16 model "
+            "config (fp16 would need loss scaling); use bfloat16/float32 "
+            "model dtypes and let the policy lift them"
+        )
+    if (
+        model_cfg.param_dtype == "bfloat16"
+        and model_cfg.compute_dtype == "bfloat16"
+    ):
+        return model_cfg
+    return dataclasses.replace(
+        model_cfg, param_dtype="bfloat16", compute_dtype="bfloat16"
+    )
+
+
 def resolve_collectives(train_cfg, model_cfg, mesh: Mesh | None = None):
     """Route ``TrainConfig.collectives`` onto the model config (the dense
     layers are where the ring schedules live — ops/overlap_collectives.py,

@@ -48,6 +48,7 @@ from dtc_tpu.train.train_step import (
     create_train_step,
     normalize_spec,
     resolve_collectives,
+    resolve_precision,
 )
 from dtc_tpu.obs import Telemetry
 from dtc_tpu.utils.dist import is_lead_process, maybe_initialize_distributed
@@ -348,6 +349,11 @@ def _train(
     # axis size, and the sequence-parallel deferral all covered) so a
     # knob that will change nothing never passes silently.
     model_cfg = resolve_collectives(train_cfg, model_cfg, mesh)
+    # Mixed precision (ISSUE 14): OptimConfig.precision lifts bf16
+    # params/compute onto the model config through the one shared
+    # definition; create_optimizer reads the same knob for the fp32
+    # master-weight wrapper, so the pair can never half-apply.
+    model_cfg = resolve_precision(opt_cfg, model_cfg)
 
     model = GPT(model_cfg)
     # LoRA finetune mode (dtc_tpu/adapters/): the TrainState is the

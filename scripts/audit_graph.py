@@ -10,6 +10,11 @@ gate on committed baselines.
     python scripts/audit_graph.py --modes dp,tp,fsdp,ep --decode \
         --write-baseline
 
+The ISSUE-14 numerics (dtype-flow + dtype-literal lint) and memory
+(static HBM plan) passes run BY DEFAULT and gate the per-entry
+``<entry>.numerics.json`` / ``<entry>.memory.json`` baselines alongside
+the graph fingerprints (--no-numerics / --no-memory to disable).
+
 Exit status: 0 iff no error-severity findings. The audit always runs on
 the 8-virtual-device CPU mesh (JAX_PLATFORMS honored, defaulting to cpu)
 so it needs no accelerator — committed baselines describe the CPU
@@ -55,6 +60,26 @@ def main() -> int:
         "fixed slots never recompiles",
     )
     p.add_argument(
+        "--numerics", dest="numerics", action="store_true", default=True,
+        help="run the dtype-flow numerics pass + dtype-literal lint and "
+        "gate the <entry>.numerics.json baselines (DEFAULT ON; "
+        "--no-numerics disables)",
+    )
+    p.add_argument(
+        "--no-numerics", dest="numerics", action="store_false",
+    )
+    p.add_argument(
+        "--memory", dest="memory", action="store_true", default=True,
+        help="build the static HBM plan per entry and gate the "
+        "<entry>.memory.json baselines (DEFAULT ON; --no-memory "
+        "disables). Prints the byte table; the obs memory_stats "
+        "watermark cross-check runs where the backend reports stats "
+        "(TPU) and prints the wired-but-unmeasured note elsewhere",
+    )
+    p.add_argument(
+        "--no-memory", dest="memory", action="store_false",
+    )
+    p.add_argument(
         "--check-baselines", action="store_true",
         help="fail when a committed baseline is missing (drift always "
         "checks against whatever baselines exist)",
@@ -83,16 +108,23 @@ def main() -> int:
     if os.environ.get("JAX_PLATFORMS", "cpu") == "cpu":
         jax.config.update("jax_platforms", "cpu")
 
+    from dtc_tpu.analysis import memory as memplan
     from dtc_tpu.analysis.lowering import TRAIN_ENTRIES, build_artifacts
     from dtc_tpu.analysis.report import (
         build_report, check_baselines, write_baselines,
     )
-    from dtc_tpu.analysis.rules import audit_artifact, audit_hostsync
+    from dtc_tpu.analysis.rules import (
+        audit_artifact, audit_dtype_literals, audit_hostsync,
+    )
 
     modes = [m for m in args.modes.split(",") if m]
     unknown = [m for m in modes if m not in TRAIN_ENTRIES]
     if unknown:
         p.error(f"unknown modes {unknown}; known: {sorted(TRAIN_ENTRIES)}")
+    sections = tuple(
+        s for s, on in (("numerics", args.numerics), ("memory", args.memory))
+        if on
+    )
 
     findings = []
     artifacts = []
@@ -101,14 +133,37 @@ def main() -> int:
         execute=not args.no_execute
     ):
         artifacts.append(art)
-        found = audit_artifact(art)
+        found = audit_artifact(
+            art, numerics=args.numerics, memory=args.memory
+        )
         findings.extend(found)
         errs = sum(1 for f in found if f.severity == "error")
         print(f"[audit] {art.name}: lowered+compiled, "
               f"{len(found)} finding(s) ({errs} error)")
+        if args.memory and art.state_bytes:
+            plan = memplan.hbm_plan(art)
+            row = " ".join(
+                f"{k}={plan[k]:,}" for k in (
+                    "params", "opt_master", "opt_moments", "activations",
+                    "comm_buffers", "total",
+                ) if k in plan
+            )
+            print(f"[audit]   hbm plan ({plan['activations_source']}): {row}")
     findings.extend(audit_hostsync())
+    if args.numerics:
+        findings.extend(audit_dtype_literals())
+    if args.memory:
+        watermark = memplan.device_watermark_bytes()
+        if watermark is None:
+            print(
+                "[audit] memory_stats watermark: unavailable on this "
+                "backend (CPU keeps no PJRT stats) — wired but unmeasured; "
+                "a TPU run cross-checks the plan against the live peak"
+            )
+        else:
+            print(f"[audit] memory_stats watermark: {watermark:,} bytes")
 
-    report = build_report(artifacts, findings)
+    report = build_report(artifacts, findings, sections=sections)
 
     if args.write_baseline:
         for path in write_baselines(report):
@@ -116,7 +171,7 @@ def main() -> int:
     else:
         drift = check_baselines(report, require=args.check_baselines)
         findings.extend(drift)
-        report = build_report(artifacts, findings)
+        report = build_report(artifacts, findings, sections=sections)
 
     if args.report:
         with open(args.report, "w") as f:

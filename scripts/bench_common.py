@@ -23,14 +23,17 @@ from bench import flagship_model_cfg  # noqa: E402  (re-export for scripts)
 
 
 def build_step(batch=32, grad_clip=1.0, weight_decay=0.1, parallel="dp",
-               collectives="xla", **model_knobs):
+               collectives="xla", precision="fp32", **model_knobs):
     """Returns (step_fn, state, batch_obj, key, (mesh, rules), model_cfg)
     for the flagship GPT-89.6M train step with the given knobs.
 
     ``parallel="fsdp"`` + ``collectives`` drive the ISSUE 12 overlap A/B
     rows: FSDP_RULES activate and the model config carries the
     collectives mode (resolve_collectives — the same lift the trainer
-    does), so the benched step is the trainer's step."""
+    does), so the benched step is the trainer's step.
+    ``precision="bf16_mixed"`` (ISSUE 14) drives the mixed-precision A/B
+    rows the same way — resolve_precision lifts bf16 params/compute onto
+    the model config and create_optimizer holds the fp32 masters."""
     import dataclasses
 
     import jax
@@ -42,13 +45,17 @@ def build_step(batch=32, grad_clip=1.0, weight_decay=0.1, parallel="dp",
     from dtc_tpu.models.gpt import GPT
     from dtc_tpu.parallel.mesh import mesh_from_config
     from dtc_tpu.parallel.sharding import DEFAULT_RULES, FSDP_RULES
-    from dtc_tpu.train.train_step import Batch, create_train_step
+    from dtc_tpu.train.train_step import (
+        Batch, create_train_step, resolve_precision,
+    )
     from dtc_tpu.train.trainer import init_state
 
     model_cfg = flagship_model_cfg(**model_knobs)
     if collectives != "xla":
         model_cfg = dataclasses.replace(model_cfg, collectives=collectives)
-    opt_cfg = OptimConfig(lr=3e-4, weight_decay=weight_decay, grad_clip=grad_clip)
+    opt_cfg = OptimConfig(lr=3e-4, weight_decay=weight_decay,
+                          grad_clip=grad_clip, precision=precision)
+    model_cfg = resolve_precision(opt_cfg, model_cfg)
     train_cfg = TrainConfig(
         seed=0, parallel=parallel, batch=batch, steps=1, log_every=1,
         output_dir="", dataset="synthetic", warmup_steps=0, prefetch=0,

@@ -13,7 +13,7 @@
 timeout -k 10 120 env JAX_PLATFORMS=cpu python -m pytest --collect-only -q -p no:cacheprovider \
   tests/test_moe.py tests/test_collectives_hlo.py \
   tests/test_generate.py tests/test_decode_fused.py tests/test_metrics.py \
-  tests/test_analysis.py \
+  tests/test_analysis.py tests/test_numerics.py tests/test_bf16.py \
   tests/test_serve.py tests/test_trace.py tests/test_devprof.py \
   tests/test_adapters.py tests/test_overlap_collectives.py \
   tests/test_router.py > /dev/null || {
@@ -30,7 +30,7 @@ timeout -k 10 120 env JAX_PLATFORMS=cpu python -m pytest --collect-only -q -p no
 # slots never recompile the decode step. ~2-3 min on this
 # 1-core host; runs anywhere (JAX_PLATFORMS=cpu, no accelerator). On an
 # INTENDED graph change: re-bless with
-#   python scripts/audit_graph.py --modes dp,tp,fsdp,ep --decode --serve --write-baseline
+#   python scripts/audit_graph.py --modes dp,tp,fsdp,ep,fsdp_overlapped,3d,bf16 --decode --serve --write-baseline
 # and commit the baseline diff.
 # (ISSUE 11 grew the entry set to 9: --decode now also audits the
 # layer-fused megakernel flavor `decode_fused_layers`, and --serve the
@@ -40,9 +40,14 @@ timeout -k 10 120 env JAX_PLATFORMS=cpu python -m pytest --collect-only -q -p no
 # the overlapped-collectives ring programs — their census requires the
 # ring transport (collective-permute / Pallas custom-calls) and forbids
 # the serialized per-layer kernel all-gathers; timeout 660 -> 960 for
-# the two extra unrolled-ring compiles.)
-timeout -k 10 960 env JAX_PLATFORMS=cpu python scripts/audit_graph.py \
-  --modes dp,tp,fsdp,ep,fsdp_overlapped,3d --decode --serve --check-baselines || {
+# the two extra unrolled-ring compiles. ISSUE 14 grows it to 12: the
+# `bf16` entry audits the bf16_mixed training mode, and the numerics
+# (dtype-flow + dtype-literal lint) and memory (static HBM plan) passes
+# run ON BY DEFAULT, gating the <entry>.numerics.json / <entry>.memory.json
+# baselines alongside the graph fingerprints; timeout 960 -> 1080 for
+# the extra lower+compile+execute pass.)
+timeout -k 10 1080 env JAX_PLATFORMS=cpu python scripts/audit_graph.py \
+  --modes dp,tp,fsdp,ep,fsdp_overlapped,3d,bf16 --decode --serve --check-baselines || {
     echo "tier-1 pre-gate: graph audit failed (see findings above)" >&2; exit 1; }
 # Pre-gate 3 (ISSUE 6): fast scheduler smoke — four requests (two sharing
 # a system-prompt prefix) through the real continuous-batching engine on

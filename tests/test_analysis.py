@@ -110,6 +110,65 @@ def test_dot_dtype_counts():
 
 
 # --------------------------------------------------------------------------
+# hlo.py bf16 edge cases (ISSUE 14): fp32-accumulation algorithm= dots,
+# the PR 11 convert-sinking pattern, and bf16 tuple-result bytes.
+# --------------------------------------------------------------------------
+
+def test_dot_entries_algorithm_attribute():
+    """A TPU dump's bf16-in/fp32-accumulate dot carries algorithm= — the
+    parser must surface it so a dtype audit reads 'MXU contract', not
+    'fp32 upcast' (the result dtype alone would mislead)."""
+    txt = (
+        "  %dot.7 = f32[8,32]{1,0} dot(bf16[8,64]{1,0} %a, bf16[64,32]{1,0} %b), "
+        "lhs_contracting_dims={1}, rhs_contracting_dims={0}, "
+        "algorithm=dot_bf16_bf16_f32, "
+        'metadata={op_name="jit(step)/fwd/dot_general"}\n'
+        "  %dot.9 = bf16[8,32]{1,0} dot(bf16[8,64]{1,0} %c, bf16[64,32]{1,0} %d)\n"
+    )
+    entries = hlo.dot_entries(txt)
+    assert entries[0] == {
+        "result_dtype": "f32",
+        "operand_dtypes": ("bf16", "bf16"),
+        "algorithm": "dot_bf16_bf16_f32",
+        "op_name": "jit(step)/fwd/dot_general",
+    }
+    assert entries[1]["algorithm"] == "" and entries[1]["op_name"] == ""
+
+
+def test_all_gather_bf16_convert_sunk():
+    """The PR 11 convert-sinking class: XLA sinks the fp32->bf16 convert
+    BELOW a param all-gather to halve wire bytes, so the gather lands a
+    bf16 buffer. The shape parsers must report the bf16 dtype (the
+    stacked-gather rule matches compute-dtype'd shapes because of exactly
+    this) and the census must count 2-byte elements."""
+    txt = "%ag = bf16[4,64,128]{2,1,0} all-gather(%w_cast), dimensions={1}\n"
+    assert hlo.all_gather_dims(txt) == [("bf16", (4, 64, 128))]
+    census = hlo.collective_census(txt)
+    assert census["all-gather"]["bytes"] == 4 * 64 * 128 * 2
+
+
+def test_tuple_result_bytes_mixed_dtypes():
+    """A combined collective's tuple result sums per-element dtype sizes
+    — a bf16 element must not be counted at 4 bytes."""
+    txt = "  %ar = (bf16[64,64]{1,0}, f32[64]{0}) all-reduce(%a, %b)\n"
+    census = hlo.collective_census(txt)
+    assert census["all-reduce"]["count"] == 1
+    assert census["all-reduce"]["bytes"] == 64 * 64 * 2 + 64 * 4
+
+
+def test_collective_dtype_census():
+    txt = (
+        "  %ar1 = f32[64]{0} all-reduce(%a)\n"
+        "  %ar2 = (bf16[8]{0}, bf16[8]{0}) all-reduce(%b, %c)\n"
+        "  %ag = bf16[4,64]{1,0} all-gather(%d)\n"
+    )
+    assert hlo.collective_dtype_census(txt) == {
+        "all-reduce": {"f32": 1, "bf16": 2},
+        "all-gather": {"bf16": 1},
+    }
+
+
+# --------------------------------------------------------------------------
 # family 1: collective census
 # --------------------------------------------------------------------------
 
@@ -283,30 +342,37 @@ def test_baseline_roundtrip_and_drift(tmp_path):
     rep = _report(_artifact())
     write_baselines(rep, d)
     assert check_baselines(rep, d) == []  # same graph: clean
-    # Drift: one extra all-reduce (count + bytes change).
+    # Drift: one extra all-reduce (count + bytes change). The HLO change
+    # also moves the ISSUE-14 numerics fingerprint (collective dtypes) —
+    # both files flag, each naming its family.
     drifted = _report(_artifact(hlo_text=_HEADER + _BODY + _BODY))
     findings = check_baselines(drifted, d)
-    assert [f.rule for f in findings] == ["baseline.drift"]
-    assert findings[0].severity == "error"
-    assert "census.all-reduce.count" in findings[0].message
+    assert [f.rule for f in findings] == ["baseline.drift"] * 2
+    by_art = {f.artifact: f for f in findings}
+    assert set(by_art) == {"train_dp", "train_dp.numerics"}
+    assert all(f.severity == "error" for f in findings)
+    assert "census.all-reduce.count" in by_art["train_dp"].message
 
 
 def test_baseline_missing_and_env_mismatch(tmp_path):
     d = str(tmp_path)
     rep = _report(_artifact())
     missing = check_baselines(rep, d, require=True)
-    assert [f.rule for f in missing] == ["baseline.missing"]
-    assert missing[0].severity == "error"
+    # Graph + numerics files both missing (this fixture has no
+    # state_bytes, so no memory section).
+    assert [f.rule for f in missing] == ["baseline.missing"] * 2
+    assert all(f.severity == "error" for f in missing)
     assert check_baselines(rep, d, require=False)[0].severity == "warn"
     # A baseline blessed under another jax: drift downgraded to warn.
     write_baselines(rep, d)
-    path = os.path.join(d, "train_dp.json")
-    blessed = json.load(open(path))
-    blessed["jax"] = "9.9.9"
-    json.dump(blessed, open(path, "w"))
+    for name in ("train_dp.json", "train_dp.numerics.json"):
+        path = os.path.join(d, name)
+        blessed = json.load(open(path))
+        blessed["jax"] = "9.9.9"
+        json.dump(blessed, open(path, "w"))
     drifted = _report(_artifact(hlo_text=_HEADER + _BODY + _BODY))
     findings = check_baselines(drifted, d)
-    assert findings[0].severity == "warn"
+    assert findings and all(f.severity == "warn" for f in findings)
 
 
 # --------------------------------------------------------------------------

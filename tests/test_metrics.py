@@ -273,3 +273,86 @@ def test_comm_bytes_3d_composes_all_terms():
     # DP reduces the per-device PARAM SHARD (tree already split by TP x PP).
     full = comm_bytes_per_step(cfg, 8, T, {"data": 2}, "dp")["dp_allreduce"]
     assert c["dp_allreduce"] == pytest.approx(full / 4)
+
+
+# --------------------------------------------------------------------------
+# train_memory_bytes (ISSUE 14): the analytic HBM model the static memory
+# audit cross-checks. Hand-computed on the tiny config.
+# --------------------------------------------------------------------------
+
+def test_train_memory_bytes_dp_fp32_hand_computed():
+    from dtc_tpu.utils.metrics import train_memory_bytes
+
+    cfg = _cfg(compute_dtype="float32", attention="dense")
+    n = _dense_param_count()
+    batch = 8
+    m = train_memory_bytes(cfg, batch, T, {"data": 8}, "dp")
+    # dp replicates params: full tree, fp32.
+    assert m["params"] == pytest.approx(n * 4.0)
+    assert m["master"] == 0.0          # fp32: the params ARE the masters
+    assert m["moments"] == pytest.approx(n * 8.0)
+    assert m["grads"] == pytest.approx(n * 4.0)
+    # Activations: per layer (10d + 2ff) per token fp32 + the dense
+    # fp32 (B, H, T, T) probs, + the logits row; batch local = 1.
+    b_loc = batch / 8
+    layer = b_loc * T * (10 * D + 2 * FF) * 4.0 + b_loc * H * T * T * 4.0
+    acts = L * layer + b_loc * T * PAD_V * 4.0
+    assert m["activations"] == pytest.approx(acts)
+    assert m["batch_io"] == pytest.approx(2 * b_loc * T * 4.0)
+    assert m["total"] == pytest.approx(
+        m["params"] + m["moments"] + m["grads"] + m["activations"]
+        + m["comm_buffers"] + m["batch_io"]
+    )
+
+
+def test_train_memory_bytes_bf16_mixed_vs_fp32():
+    """The byte story the PERF table tells: bf16_mixed halves params and
+    grads, adds a 4 B/param master row, keeps fp32 moments — state is
+    14 vs 12 B/param, compute-path buffers halve."""
+    from dtc_tpu.utils.metrics import train_memory_bytes
+
+    cfg32 = _cfg(compute_dtype="float32", attention="dense")
+    cfgbf = _cfg(
+        compute_dtype="bfloat16", param_dtype="bfloat16", attention="dense"
+    )
+    n = _dense_param_count()
+    f = train_memory_bytes(cfg32, 8, T, {"data": 1}, "dp")
+    b = train_memory_bytes(cfgbf, 8, T, {"data": 1}, "dp",
+                           precision="bf16_mixed")
+    assert b["params"] == pytest.approx(f["params"] / 2)
+    assert b["grads"] == pytest.approx(f["grads"] / 2)
+    assert b["master"] == pytest.approx(n * 4.0)
+    assert b["moments"] == f["moments"]
+    # State per param: 2 + 4 + 8 = 14 vs 12.
+    state_b = b["params"] + b["master"] + b["moments"]
+    state_f = f["params"] + f["master"] + f["moments"]
+    assert state_b == pytest.approx(n * 14.0)
+    assert state_f == pytest.approx(n * 12.0)
+
+
+def test_train_memory_bytes_fsdp_shards_state():
+    from dtc_tpu.utils.metrics import train_memory_bytes
+
+    cfg = _cfg(compute_dtype="float32", attention="dense")
+    dp = train_memory_bytes(cfg, 8, T, {"data": 8}, "dp")
+    fsdp = train_memory_bytes(cfg, 8, T, {"data": 8}, "fsdp")
+    # ZeRO-3: params/masters/moments/grads all shard by the data degree.
+    assert fsdp["params"] == pytest.approx(dp["params"] / 8)
+    assert fsdp["moments"] == pytest.approx(dp["moments"] / 8)
+    # Activations are untouched by FSDP.
+    assert fsdp["activations"] == pytest.approx(dp["activations"])
+
+
+def test_train_memory_bytes_remat_mlp_drops_ff_intermediates():
+    from dtc_tpu.utils.metrics import train_memory_bytes
+
+    full = train_memory_bytes(
+        _cfg(compute_dtype="float32", attention="dense"), 8, T,
+        {"data": 1}, "dp",
+    )
+    mlp = train_memory_bytes(
+        _cfg(compute_dtype="float32", attention="dense", remat="mlp"), 8, T,
+        {"data": 1}, "dp",
+    )
+    drop = L * 8 * T * 2 * FF * 4.0  # the d_ff-wide fc1/gelu intermediates
+    assert full["activations"] - mlp["activations"] == pytest.approx(drop)

@@ -1,0 +1,570 @@
+"""Numerics + memory auditor tests (ISSUE 14).
+
+Same two-layer structure as tests/test_analysis.py: the rule families on
+FABRICATED evidence (every rule demonstrated non-vacuous — including the
+acceptance-criteria case of ``bf16_mixed`` declared over an all-fp32
+lowering), the parsers on hand-written StableHLO text, and a slow
+green-path leg lowering the real ``bf16`` registry entry against its
+committed baselines.
+"""
+
+import dataclasses
+import os
+
+import pytest
+
+from dtc_tpu.analysis import dtypelint, memory, numerics
+from dtc_tpu.analysis.lowering import Artifact
+from dtc_tpu.analysis.rules import (
+    audit_dtype_literals,
+    audit_memory,
+    audit_numerics,
+)
+
+# --------------------------------------------------------------------------
+# fabricated StableHLO snippets
+# --------------------------------------------------------------------------
+
+#: healthy bf16 program: bf16 dot, f32-accumulating score dot (bf16
+#: operands, f32 result), its autodiff transpose (one upcast operand),
+#: fp32 softmax exp + LN rsqrt.
+_SH_BF16 = """\
+module @jit_step {
+  func.func public @main(%arg0: tensor<8x64xbf16>, %arg1: tensor<64x64xbf16>) -> tensor<8x64xf32> {
+    %0 = stablehlo.dot_general %arg0, %arg1, contracting_dims = [1] x [0] : (tensor<8x64xbf16>, tensor<64x64xbf16>) -> tensor<8x64xbf16>
+    %1 = stablehlo.dot_general %arg0, %arg1, contracting_dims = [1] x [0] : (tensor<8x64xbf16>, tensor<64x64xbf16>) -> tensor<8x64xf32>
+    %2 = stablehlo.convert %arg1 : (tensor<64x64xbf16>) -> tensor<64x64xf32>
+    %3 = stablehlo.dot_general %1, %2, contracting_dims = [1] x [0] : (tensor<8x64xf32>, tensor<64x64xf32>) -> tensor<8x64xf32>
+    %4 = stablehlo.exponential %3 : tensor<8x64xf32>
+    %5 = stablehlo.rsqrt %3 : tensor<8x64xf32>
+    return %4 : tensor<8x64xf32>
+  }
+}
+"""
+
+#: the cast-then-dot LEAK: both operands upcast bf16->f32 then dotted.
+_SH_UPCAST = """\
+module @jit_step {
+  func.func public @main(%arg0: tensor<8x64xbf16>, %arg1: tensor<64x64xbf16>) -> tensor<8x64xf32> {
+    %0 = stablehlo.convert %arg0 : (tensor<8x64xbf16>) -> tensor<8x64xf32>
+    %1 = stablehlo.convert %arg1 : (tensor<64x64xbf16>) -> tensor<64x64xf32>
+    %2 = stablehlo.dot_general %0, %1, contracting_dims = [1] x [0] : (tensor<8x64xf32>, tensor<64x64xf32>) -> tensor<8x64xf32>
+    return %2 : tensor<8x64xf32>
+  }
+}
+"""
+
+#: bf16-downcast softmax/LN: the dangerous-downcast case.
+_SH_BF16_EXP = """\
+module @jit_step {
+  func.func public @main(%arg0: tensor<8x64xbf16>) -> tensor<8x64xbf16> {
+    %0 = stablehlo.exponential %arg0 : tensor<8x64xbf16>
+    %1 = stablehlo.rsqrt %arg0 : tensor<8x64xbf16>
+    %2 = stablehlo.dot_general %0, %1, contracting_dims = [1] x [0] : (tensor<8x64xbf16>, tensor<8x64xbf16>) -> tensor<8x8xbf16>
+    return %0 : tensor<8x64xbf16>
+  }
+}
+"""
+
+#: layer scan with OUTLINED body (the real jax shape): the while body
+#: slices the stacked f32 params, calls @None, and @None downcasts its
+#: param arg per layer — the cast-churn fingerprint. One extra convert
+#: of an ACTIVATION arg rides along and must NOT be counted.
+_SH_SCAN_CHURN = """\
+module @jit_step {
+  func.func public @main(%arg0: tensor<4x64x64xf32>, %arg1: tensor<8x64xbf16>) -> tensor<8x64xbf16> {
+    %c0 = stablehlo.constant dense<0> : tensor<i32>
+    %51:2 = stablehlo.while(%iterArg = %arg0, %iterArg_1 = %arg1) : tensor<4x64x64xf32>, tensor<8x64xbf16>
+     cond {
+      %90 = stablehlo.compare LT, %c0, %c0 : (tensor<i32>, tensor<i32>) -> tensor<i1>
+      stablehlo.return %90 : tensor<i1>
+    } do {
+      %100 = stablehlo.dynamic_slice %iterArg, %c0, %c0, %c0, sizes = [1, 64, 64] : (tensor<4x64x64xf32>, tensor<i32>, tensor<i32>, tensor<i32>) -> tensor<1x64x64xf32>
+      %101 = stablehlo.reshape %100 : (tensor<1x64x64xf32>) -> tensor<64x64xf32>
+      %102 = func.call @None(%101, %iterArg_1) : (tensor<64x64xf32>, tensor<8x64xbf16>) -> tensor<8x64xbf16>
+      stablehlo.return %iterArg, %102 : tensor<4x64x64xf32>, tensor<8x64xbf16>
+    }
+    return %51#1 : tensor<8x64xbf16>
+  }
+  func.func private @None(%arg0: tensor<64x64xf32>, %arg1: tensor<8x64xbf16>) -> tensor<8x64xbf16> {
+    %0 = stablehlo.convert %arg0 : (tensor<64x64xf32>) -> tensor<64x64xbf16>
+    %1 = stablehlo.convert %arg1 : (tensor<8x64xbf16>) -> tensor<8x64xf32>
+    %2 = stablehlo.convert %1 : (tensor<8x64xf32>) -> tensor<8x64xbf16>
+    %3 = stablehlo.dot_general %2, %0, contracting_dims = [1] x [0] : (tensor<8x64xbf16>, tensor<64x64xbf16>) -> tensor<8x64xbf16>
+    return %3 : tensor<8x64xbf16>
+  }
+}
+"""
+
+#: all-fp32 program (what "told bf16_mixed over today's lowering" sees).
+_SH_FP32 = """\
+module @jit_step {
+  func.func public @main(%arg0: tensor<8x64xf32>, %arg1: tensor<64x64xf32>) -> tensor<8x64xf32> {
+    %0 = stablehlo.dot_general %arg0, %arg1, contracting_dims = [1] x [0] : (tensor<8x64xf32>, tensor<64x64xf32>) -> tensor<8x64xf32>
+    %1 = stablehlo.exponential %0 : tensor<8x64xf32>
+    %2 = stablehlo.rsqrt %0 : tensor<8x64xf32>
+    return %1 : tensor<8x64xf32>
+  }
+}
+"""
+
+_HLO_HEADER = (
+    "HloModule jit_step, is_scheduled=true, "
+    "input_output_alias={ {0}: (0, {}, may-alias) }, "
+    "entry_computation_layout={(f32[64,64]{1,0}, s32[8,32]{1,0}, "
+    "s32[8,32]{1,0}, u32[2]{0})->(f32[64,64]{1,0}, f32[])}\n"
+)
+_HLO_BODY = "  %all-reduce.1 = f32[64,64]{1,0} all-reduce(%p0)\n"
+
+
+def _artifact(**over) -> Artifact:
+    base = dict(
+        name="train_dp",
+        kind="train",
+        parallel="dp",
+        mesh_shape={"pipe": 1, "data": 8, "model": 1},
+        batch=8,
+        seq_len=32,
+        hlo_text=_HLO_HEADER + _HLO_BODY,
+        stablehlo_text=_SH_FP32,
+        expected_donated=1,
+        param_shapes=[],
+        weak_outputs=0,
+        n_layers=4,
+        moe_experts=0,
+        compute_dtype="float32",
+        cold_compiles=1,
+        steady_compiles=0,
+        comm_estimate=None,
+        precision="fp32",
+        loss_dtype="f32",
+        state_bytes={"params": 16384, "opt_moments": 0, "opt_other": 0},
+        state_dtypes={"params": ["f32"], "opt_moments": ["f32"]},
+        batch_bytes=2 * 8 * 32 * 4 + 8,
+        mem_stats=None,
+        mem_estimate=None,
+    )
+    base.update(over)
+    return Artifact(**base)
+
+
+def _errors(findings, rule_prefix=""):
+    return [
+        f for f in findings
+        if f.severity == "error" and f.rule.startswith(rule_prefix)
+    ]
+
+
+# --------------------------------------------------------------------------
+# numerics.py parsers on fabricated text
+# --------------------------------------------------------------------------
+
+def test_dot_signature_census_classifies():
+    dots = numerics.dot_signature_census(_SH_BF16)
+    # bf16xbf16->bf16 and bf16xbf16->f32 (f32 ACCUMULATION) both count as
+    # the bf16 region; the transpose dot (f32 cotangent x upcast primal)
+    # is its own benign bucket.
+    assert dots == {
+        "bf16_bf16": 2, "bf16_mixed": 0, "f32_f32": 0,
+        "f32_transpose": 1, "f32_upcast": 0, "other": 0,
+    }
+
+
+def test_dot_census_flags_double_upcast_leak():
+    dots = numerics.dot_signature_census(_SH_UPCAST)
+    assert dots["f32_upcast"] == 1
+    assert dots["f32_transpose"] == 0
+
+
+def test_dot_census_ignores_algorithm_attr_types():
+    # The algorithm attribute names dtypes inside <...>; the signature
+    # split must read the REAL operand types after the last " : ".
+    txt = (
+        "module @m {\n"
+        "  func.func public @main(%arg0: tensor<8x8xbf16>) -> tensor<8x8xf32> {\n"
+        "    %0 = stablehlo.dot_general %arg0, %arg0, contracting_dims = [1] x [0],"
+        " algorithm = <lhs_precision_type = bf16, rhs_precision_type = bf16,"
+        " accumulation_type = f32> : (tensor<8x8xbf16>, tensor<8x8xbf16>) -> tensor<8x8xf32>\n"
+        "    return %0 : tensor<8x8xf32>\n"
+        "  }\n"
+        "}\n"
+    )
+    assert numerics.dot_signature_census(txt)["bf16_bf16"] == 1
+
+
+def test_fp32_region_census():
+    assert numerics.fp32_region_census(_SH_BF16) == {
+        "exponential": {"f32": 1}, "rsqrt": {"f32": 1},
+    }
+    low = numerics.fp32_region_census(_SH_BF16_EXP)
+    assert low["exponential"] == {"bf16": 1}
+    assert low["rsqrt"] == {"bf16": 1}
+
+
+def test_scan_convert_census_outlined_body():
+    c = numerics.scan_convert_census(_SH_SCAN_CHURN)
+    # @None is called from the while body: its param-arg downcast counts
+    # (the call site feeds a slice-of-carry), the activation round-trip
+    # does not (its root arg position is fed by the carry directly).
+    assert c["param_slice_downcast"] == 1
+    assert c["f32_to_bf16"] == 2  # param cast + activation round-trip
+    assert c["bf16_to_f32"] == 1
+
+
+def test_scan_convert_census_ignores_top_level():
+    # The same converts OUTSIDE any while body are not churn.
+    assert numerics.scan_convert_census(_SH_UPCAST) == {
+        "f32_to_bf16": 0, "bf16_to_f32": 0, "param_slice_downcast": 0,
+    }
+
+
+# --------------------------------------------------------------------------
+# family 6: numerics rules
+# --------------------------------------------------------------------------
+
+def test_bf16_mixed_over_fp32_program_trips():
+    """THE acceptance-criteria case: the auditor must trip when told
+    bf16_mixed over today's all-fp32 lowering — zero bf16 matmuls and no
+    master weights is not a lowered policy, whatever the config says."""
+    a = _artifact(
+        precision="bf16_mixed",
+        state_dtypes={"params": ["f32"], "opt_moments": ["f32"]},
+    )
+    found = audit_numerics(a)
+    assert _errors(found, "numerics.matmul_region")
+    assert _errors(found, "numerics.optimizer_state")  # no f32 masters
+
+
+def test_healthy_bf16_mixed_is_clean():
+    a = _artifact(
+        precision="bf16_mixed",
+        stablehlo_text=_SH_BF16,
+        state_dtypes={
+            "params": ["bf16", "f32"], "opt_moments": ["f32"],
+            "opt_master": ["f32"],
+        },
+    )
+    assert audit_numerics(a) == []
+
+
+def test_upcast_leak_trips():
+    a = _artifact(stablehlo_text=_SH_BF16 + _SH_UPCAST)
+    assert _errors(audit_numerics(a), "numerics.upcast_leak")
+
+
+def test_bf16_softmax_ln_trips_under_any_policy():
+    a = _artifact(stablehlo_text=_SH_BF16_EXP)
+    found = _errors(audit_numerics(a), "numerics.fp32_mandatory")
+    assert len(found) == 2  # exponential AND rsqrt
+
+
+def test_cast_churn_warns_fp32_errors_bf16():
+    a = _artifact(stablehlo_text=_SH_SCAN_CHURN)
+    warns = [f for f in audit_numerics(a) if f.rule == "numerics.cast_churn"]
+    assert warns and warns[0].severity == "warn"
+    a_bf16 = _artifact(
+        stablehlo_text=_SH_SCAN_CHURN,
+        precision="bf16_mixed",
+        state_dtypes={
+            "params": ["bf16", "f32"], "opt_moments": ["f32"],
+            "opt_master": ["f32"],
+        },
+    )
+    assert _errors(audit_numerics(a_bf16), "numerics.cast_churn")
+
+
+def test_loss_dtype_and_moment_dtype_trip():
+    assert _errors(
+        audit_numerics(_artifact(loss_dtype="bf16")), "numerics.loss_dtype"
+    )
+    assert _errors(
+        audit_numerics(_artifact(
+            state_dtypes={"params": ["f32"], "opt_moments": ["bf16"]},
+        )),
+        "numerics.optimizer_state",
+    )
+
+
+def test_bf16_collective_under_fp32_policy_trips():
+    body = "  %all-reduce.9 = bf16[64,64]{1,0} all-reduce(%g)\n"
+    a = _artifact(hlo_text=_HLO_HEADER + _HLO_BODY + body)
+    assert _errors(audit_numerics(a), "numerics.grad_accum_downcast")
+    # Under bf16_mixed the bf16 wire is the documented choice: no error.
+    a2 = _artifact(
+        hlo_text=_HLO_HEADER + _HLO_BODY + body,
+        stablehlo_text=_SH_BF16,
+        precision="bf16_mixed",
+        state_dtypes={
+            "params": ["bf16", "f32"], "opt_moments": ["f32"],
+            "opt_master": ["f32"],
+        },
+    )
+    assert not _errors(audit_numerics(a2), "numerics.grad_accum_downcast")
+
+
+# --------------------------------------------------------------------------
+# family 7: static memory plan
+# --------------------------------------------------------------------------
+
+def test_entry_io_bytes_parse():
+    assert memory.entry_input_bytes(_HLO_HEADER) == (
+        64 * 64 * 4 + 2 * 8 * 32 * 4 + 2 * 4
+    )
+    assert memory.entry_output_bytes(_HLO_HEADER) == 64 * 64 * 4 + 4
+
+
+def test_hbm_plan_hand_computed():
+    a = _artifact(
+        state_bytes={"params": 16384, "opt_moments": 32768, "opt_other": 8},
+        batch_bytes=2048,
+        mem_stats={"argument": 0, "output": 0, "temp": 4096, "alias": 0},
+    )
+    plan = memory.hbm_plan(a)
+    assert plan["params"] == 16384
+    assert plan["comm_buffers"] == 64 * 64 * 4  # the all-reduce result
+    assert plan["activations"] == 4096
+    assert plan["activations_source"] == "xla_temp"
+    assert plan["total"] == 16384 + 32768 + 8 + 2048 + 4096 + 64 * 64 * 4
+
+
+def test_hbm_plan_analytic_fallback():
+    a = _artifact(mem_estimate={"activations": 999.0, "total": 5e4})
+    plan = memory.hbm_plan(a)
+    assert plan["activations"] == 999
+    assert plan["activations_source"] == "analytic"
+
+
+def test_entry_decomposition_trips_on_rot():
+    # Claimed state bytes wildly off the module's entry layout.
+    a = _artifact(state_bytes={"params": 4}, batch_bytes=0)
+    assert _errors(audit_memory(a), "memory.entry_decomposition")
+
+
+def test_entry_decomposition_clean_on_match():
+    a = _artifact(
+        state_bytes={"params": 64 * 64 * 4},
+        batch_bytes=2 * 8 * 32 * 4 + 8,
+    )
+    assert not _errors(audit_memory(a), "memory.entry_decomposition")
+
+
+def test_master_weight_rule_trips_when_told_bf16_over_fp32():
+    a = _artifact(
+        precision="bf16_mixed",
+        stablehlo_text=_SH_BF16,
+        state_bytes={"params": 64 * 64 * 4},
+        batch_bytes=2 * 8 * 32 * 4 + 8,
+    )
+    assert _errors(audit_memory(a), "memory.master_weights")
+
+
+def test_master_weight_rule_accepts_real_bf16_plan():
+    # params = bf16 payload (half the masters) + no LN islands here.
+    sb = {"params": 64 * 64 * 2, "opt_master": 64 * 64 * 4}
+    header = _HLO_HEADER.replace(
+        "(f32[64,64]{1,0}, ", "(bf16[64,64]{1,0}, f32[64,64]{1,0}, "
+    )
+    a = _artifact(
+        precision="bf16_mixed",
+        stablehlo_text=_SH_BF16,
+        hlo_text=header + _HLO_BODY,
+        state_bytes=sb,
+        batch_bytes=2 * 8 * 32 * 4 + 8,
+    )
+    assert not _errors(audit_memory(a), "memory.master_weights")
+
+
+def test_memory_cross_check_warns_when_far_off():
+    a = _artifact(
+        state_bytes={"params": 64 * 64 * 4},
+        batch_bytes=2 * 8 * 32 * 4 + 8,
+        mem_estimate={"activations": 0.0, "total": 1e12},
+    )
+    found = audit_memory(a)
+    warns = [f for f in found if f.rule == "memory.bytes_cross_check"]
+    assert warns and warns[0].severity == "warn"
+    assert not _errors(found)
+
+
+# --------------------------------------------------------------------------
+# family 8: dtype-literal lint
+# --------------------------------------------------------------------------
+
+_BAD_OP_SRC = """\
+import jax.numpy as jnp
+
+def hot_matmul(x, w):
+    # A hard-coded upcast in a hot path: exactly what the lint hunts.
+    return (x.astype(jnp.float32) @ w.astype(jnp.float32))
+
+def softmax_island(s):
+    return jnp.exp(s.astype(jnp.float32))
+"""
+
+
+def test_dtype_lint_trips_on_unsanctioned_literal():
+    sites = dtypelint.lint_source(_BAD_OP_SRC, "fake.py", "ops/fake.py")
+    bad = dtypelint.unsanctioned(sites)
+    # No allowlist row for ops/fake.py: every literal is unsanctioned.
+    assert len(bad) == 3
+    assert {s.scope[-1] for s in bad} == {"hot_matmul", "softmax_island"}
+
+
+def test_dtype_lint_catches_string_dtype_literals():
+    """The satellite names `.astype(...)` explicitly: the STRING form
+    (`.astype("float32")`, `dtype="bfloat16"`) must trip like the
+    attribute form — while bare string comparisons (config plumbing)
+    stay out of scope."""
+    src = (
+        "import jax.numpy as jnp\n"
+        "def hot(x):\n"
+        "    return x.astype('float32')\n"
+        "def alloc(x):\n"
+        "    return jnp.zeros_like(x, dtype='bfloat16')\n"
+        "def plumbing(cfg):\n"
+        "    return cfg.param_dtype == 'float32'\n"
+    )
+    sites = dtypelint.lint_source(src, "f.py", "ops/f.py")
+    assert sorted((s.dtype, s.scope[-1]) for s in sites) == [
+        ("bfloat16", "alloc"), ("float32", "hot"),
+    ]
+
+
+def test_audit_artifact_flags_bypass_new_families():
+    """audit_graph's --no-numerics/--no-memory must ACTUALLY bypass the
+    rule passes, not just their baselines (review finding, this PR)."""
+    from dtc_tpu.analysis.rules import audit_artifact
+
+    lied = _artifact(
+        precision="bf16_mixed",
+        state_dtypes={"params": ["f32"], "opt_moments": ["f32"]},
+    )
+    assert _errors(audit_artifact(lied), "numerics.")
+    assert not _errors(
+        audit_artifact(lied, numerics=False), "numerics."
+    )
+    rot = _artifact(state_bytes={"params": 4}, batch_bytes=0)
+    assert _errors(audit_artifact(rot), "memory.")
+    assert not _errors(audit_artifact(rot, memory=False), "memory.")
+
+
+def test_dtype_lint_allowlist_sanctions_scope(monkeypatch):
+    monkeypatch.setitem(
+        dtypelint.ALLOWLIST, "ops/fake.py", frozenset({"softmax_island"})
+    )
+    sites = dtypelint.lint_source(_BAD_OP_SRC, "fake.py", "ops/fake.py")
+    bad = dtypelint.unsanctioned(sites)
+    assert len(bad) == 2 and all(
+        s.scope[-1] == "hot_matmul" for s in bad
+    )
+
+
+def test_pristine_tree_lints_clean():
+    """The satellite's standing assertion: every hard-coded dtype literal
+    in models/ and ops/ sits in a sanctioned mandated-precision scope. A
+    new naked literal anywhere else fails THIS test (and the audit
+    pre-gate) until allowlisted with a justification."""
+    assert audit_dtype_literals() == [], [
+        f.message for f in audit_dtype_literals()
+    ]
+    # And the lint actually sees the tree (a path bug would pass
+    # vacuously — same guard as the hostsync lint's non-empty assert).
+    assert len(dtypelint.lint_tree()) > 50
+
+
+def test_allowlist_names_still_exist():
+    """Scope names in the allowlist must exist in their files — a
+    renamed kernel function would otherwise leave a stale sanction
+    behind (the hostsync SANCTIONED_CONDITIONS contract)."""
+    import ast
+
+    pkg = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "dtc_tpu")
+    for rel, names in dtypelint.ALLOWLIST.items():
+        path = os.path.join(pkg, rel)
+        assert os.path.exists(path), rel
+        tree = ast.parse(open(path).read())
+        defined = {
+            n.name for n in ast.walk(tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.ClassDef))
+        }
+        for name in names - {"*", "<module>"}:
+            assert name in defined, f"{rel}: stale allowlist scope {name!r}"
+
+
+# --------------------------------------------------------------------------
+# baseline sections round-trip
+# --------------------------------------------------------------------------
+
+def test_numerics_memory_baseline_sections_roundtrip(tmp_path):
+    from dtc_tpu.analysis.report import (
+        build_report, check_baselines, write_baselines,
+    )
+
+    d = str(tmp_path)
+    rep = build_report([_artifact()], [])
+    assert "numerics" in rep and "memory" in rep
+    paths = write_baselines(rep, d)
+    assert {os.path.basename(p) for p in paths} == {
+        "train_dp.json", "train_dp.numerics.json", "train_dp.memory.json",
+    }
+    assert check_baselines(rep, d) == []
+    # Numerics-ONLY drift: a state-class dtype changes (the graph and
+    # memory fingerprints never read state_dtypes).
+    drifted = build_report(
+        [_artifact(state_dtypes={"params": ["f32"],
+                                 "opt_moments": ["bf16"]})], []
+    )
+    findings = check_baselines(drifted, d)
+    assert {f.artifact for f in findings if f.severity == "error"} == {
+        "train_dp.numerics"
+    }
+    # Memory drift: a state byte moves.
+    drifted2 = build_report(
+        [_artifact(state_bytes={"params": 16385, "opt_moments": 0,
+                                "opt_other": 0})], []
+    )
+    findings2 = check_baselines(drifted2, d)
+    assert {f.artifact for f in findings2 if f.severity == "error"} == {
+        "train_dp.memory"
+    }
+
+
+# --------------------------------------------------------------------------
+# green path: the real bf16 entry vs its committed baselines
+# --------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_green_path_bf16_matches_committed_baseline():
+    """Acceptance leg: the REAL bf16_mixed train step lowers through the
+    registry, audits clean under every family (bf16 matmuls present, no
+    churn, masters fp32, memory plan self-consistent), and matches the
+    committed graph + numerics + memory baselines."""
+    from dtc_tpu.analysis.lowering import build_train_artifact
+    from dtc_tpu.analysis.report import build_report, check_baselines
+    from dtc_tpu.analysis.rules import audit_artifact
+
+    art = build_train_artifact("bf16", execute=True)
+    findings = audit_artifact(art)
+    assert not _errors(findings), [f.message for f in findings]
+    dots = numerics.dot_signature_census(art.stablehlo_text)
+    assert dots["bf16_bf16"] > 0  # the policy actually lowered
+    plan = memory.hbm_plan(art)
+    assert plan["opt_master"] > 0
+    assert plan["opt_master"] // 2 <= plan["params"] <= plan["opt_master"]
+    drift = check_baselines(build_report([art], findings))
+    assert not drift, [f.message for f in drift]
+
+
+@pytest.mark.slow
+def test_fp32_program_labeled_bf16_trips_end_to_end():
+    """The non-vacuousness proof on the REAL lowering (not a fixture):
+    take the committed fp32 dp artifact, relabel it bf16_mixed, and the
+    numerics + memory families must both error."""
+    from dtc_tpu.analysis.lowering import build_train_artifact
+
+    art = build_train_artifact("dp", execute=False)
+    lied = dataclasses.replace(art, precision="bf16_mixed")
+    assert _errors(audit_numerics(lied), "numerics.matmul_region")
+    assert _errors(audit_memory(lied), "memory.master_weights")
