@@ -494,6 +494,13 @@ class GuardConfig:
     spike_factor: float = 0.0
     spike_window: int = 32       # trailing window-means kept for the median
     max_rollbacks: int = 3       # ladder rung 3: abort after this many
+    # Forgiveness (ISSUE 15 satellite): after this many CONSECUTIVE healthy
+    # log WINDOWS (check_window calls — i.e. log_every steps each, NOT raw
+    # steps), the rollback counter resets to 0 — ``max_rollbacks`` then
+    # bounds rollbacks per incident, not per run lifetime (a lifetime
+    # budget makes a week-long run die on its Nth well-separated
+    # transient). 0 = legacy lifetime budget.
+    clean_steps_to_forgive: int = 0
     # Rung 1: wrap the optimizer in optax.apply_if_finite so non-finite
     # updates are SKIPPED device-side (no sync). Changes the optimizer
     # state pytree — checkpoints do not carry across toggling this.
@@ -505,6 +512,10 @@ class GuardConfig:
             raise ValueError("spike_factor must be >= 0 (0 = disabled)")
         if self.max_rollbacks < 0:
             raise ValueError("max_rollbacks must be >= 0")
+        if self.clean_steps_to_forgive < 0:
+            raise ValueError(
+                "clean_steps_to_forgive must be >= 0 (0 = lifetime budget)"
+            )
 
 
 @dataclass(frozen=True)
@@ -594,6 +605,21 @@ class ChaosConfig:
     fleet_partition_at_step: int = 0      # target replica unreachable for N iterations
     fleet_partition_iters: int = 2        # partition length (router iterations)
     fleet_target_replica: int = 0         # victim replica index for fleet faults
+    # --- elastic faults (dtc_tpu/resilience/elastic.py + snapshot.py,
+    # ISSUE 15; step numbers are trainer loop steps, elastic_target_host
+    # picks the victim virtual host). Kill drives heartbeat detection +
+    # shrink-and-continue from the in-memory snapshot; slow drives the
+    # straggler flag (host_slow, NOT a kill — detection specificity);
+    # lose_snapshot drops the victim's primary hot-tier copy so recovery
+    # must take the ring mirror; torn_cold_spill truncates the cold-tier
+    # (Orbax) checkpoint written at that step so the verified-checkpoint
+    # fallback must catch it.
+    kill_host_at_step: int = 0        # victim host stops heartbeating at step N
+    slow_host_at_step: int = 0        # victim host's beats arrive late from step N
+    slow_host_iters: int = 1          # straggle length (steps); < miss_limit heals
+    lose_snapshot_at_step: int = 0    # drop the victim's primary snapshot copy
+    torn_cold_spill_at_step: int = 0  # truncate the cold checkpoint written at step N
+    elastic_target_host: int = 0      # victim virtual host for elastic faults
 
     def __post_init__(self) -> None:
         if self.corrupt_mode not in ("truncate", "flip"):
@@ -604,6 +630,85 @@ class ChaosConfig:
             raise ValueError("fleet_partition_iters must be >= 1")
         if self.fleet_target_replica < 0:
             raise ValueError("fleet_target_replica must be >= 0")
+        if self.slow_host_iters < 1:
+            raise ValueError("slow_host_iters must be >= 1")
+        if self.elastic_target_host < 0:
+            raise ValueError("elastic_target_host must be >= 0")
+
+
+@dataclass(frozen=True)
+class ElasticConfig:
+    """Elastic training (``dtc_tpu/resilience/elastic.py`` +
+    ``snapshot.py``, ISSUE 15): async in-memory snapshots of the
+    TrainState on a step cadence, peer-redundant per-virtual-host shard
+    stores (DP replicas are natural full copies; FSDP shards ring-mirror
+    to a neighbor host), heartbeat host-loss detection, and
+    shrink-and-continue recovery — rebuild a smaller mesh from the
+    survivors, re-shard the snapshot onto it, and keep training. See
+    README "Elastic training".
+
+    Batch semantics on shrink: the GLOBAL batch is preserved and the
+    PER-DEVICE batch rescales (8 -> 4 devices doubles it), so the data
+    stream, token budget (``steps``), and loss trajectory stay
+    comparable; the global batch must divide the shrunk data axis. The
+    data layer's tokens-consumed accounting
+    (``dtc_tpu.data.synthetic.synthetic_row_batches``) is
+    batch-shape-independent, so a policy that changes the global batch
+    re-seeks by tokens — pinned in tests/test_data.py.
+    """
+
+    enabled: bool = False
+    # Hot-tier snapshot cadence (steps). 1 = every step (the <=1-step-
+    # lost-work guarantee); the copy is async + double-buffered, so the
+    # hot loop never blocks on it.
+    snapshot_every: int = 1
+    # Committed snapshots retained (ring). Must cover at least one
+    # snapshot at or before the last healthy log boundary for the
+    # anomaly path: keep >= log_every / snapshot_every + 1.
+    keep: int = 4
+    # Virtual hosts the device set splits into (contiguous groups; must
+    # divide the device count). On a real pod this is process_count.
+    n_virtual_hosts: int = 2
+    # Consecutive missed heartbeats before a host is declared lost. A
+    # hung-step watchdog flag (collective stall) escalates: one missed
+    # beat then suffices.
+    heartbeat_miss_limit: int = 2
+    # Cold-tier (Orbax) cadence override: with elastic on, the disk
+    # checkpoint is DEMOTED to the slow/catastrophic tier — set this
+    # slower than snapshot_every x log_every. 0 = keep
+    # TrainConfig.checkpoint_every unchanged.
+    cold_every: int = 0
+    # Persist the restored snapshot as a verified cold-tier checkpoint
+    # immediately after an elastic resize (the new disk base — a second
+    # loss before the next cold save would otherwise be unrecoverable).
+    spill_on_resize: bool = True
+    # Hosts already lost at startup: a shrunk RESTART comes up directly
+    # on the survivors' mesh (resuming from the spilled checkpoint) —
+    # the same path the in-run shrink takes, minus the detection.
+    dead_hosts: tuple = ()
+
+    def __post_init__(self) -> None:
+        if not isinstance(self.dead_hosts, tuple):  # YAML list coercion
+            object.__setattr__(self, "dead_hosts", tuple(self.dead_hosts))
+        if self.snapshot_every < 1:
+            raise ValueError("elastic.snapshot_every must be >= 1")
+        if self.keep < 2:
+            raise ValueError("elastic.keep must be >= 2 (double buffer)")
+        if self.n_virtual_hosts < 2:
+            raise ValueError("elastic.n_virtual_hosts must be >= 2")
+        if self.heartbeat_miss_limit < 1:
+            raise ValueError("elastic.heartbeat_miss_limit must be >= 1")
+        if self.cold_every < 0:
+            raise ValueError("elastic.cold_every must be >= 0 (0 = keep)")
+        if any(h < 0 for h in self.dead_hosts):
+            raise ValueError("elastic.dead_hosts entries must be >= 0")
+        if any(h >= self.n_virtual_hosts for h in self.dead_hosts):
+            raise ValueError(
+                f"elastic.dead_hosts {self.dead_hosts} outside "
+                f"n_virtual_hosts={self.n_virtual_hosts}"
+            )
+        if len(self.dead_hosts) >= self.n_virtual_hosts:
+            raise ValueError("elastic.dead_hosts names every host dead")
 
 
 @dataclass(frozen=True)
@@ -615,11 +720,46 @@ class ResilienceConfig:
     watchdog: WatchdogConfig = field(default_factory=WatchdogConfig)
     stream_retry: StreamRetryConfig = field(default_factory=StreamRetryConfig)
     chaos: ChaosConfig = field(default_factory=ChaosConfig)
+    # Elastic training: in-memory snapshots, peer redundancy, host-loss
+    # detection, shrink-and-continue — see ElasticConfig above.
+    elastic: ElasticConfig = field(default_factory=ElasticConfig)
     # Verified checkpoints (checksum manifest + intact-step fallback).
     # Costs the async-save overlap: every save waits for Orbax and the
     # lead process sha256-hashes the step. Turn off to restore pure async
     # saves when save cadence dominates (no integrity fallback then).
     verify_checkpoints: bool = True
+    # Checkpoint retention: newest N steps kept, older VERIFIED-superseded
+    # steps (and their manifest/stream sidecars) garbage-collected after
+    # each save (ISSUE 15 satellite — long runs used to accumulate steps
+    # unboundedly outside the replay path).
+    checkpoint_keep_n: int = 3
+
+    def __post_init__(self) -> None:
+        if self.checkpoint_keep_n < 1:
+            raise ValueError("checkpoint_keep_n must be >= 1")
+        if (
+            self.chaos.enabled
+            and not self.elastic.enabled
+            and (
+                self.chaos.kill_host_at_step
+                or self.chaos.slow_host_at_step
+                or self.chaos.lose_snapshot_at_step
+            )
+        ):
+            raise ValueError(
+                "chaos elastic faults (kill_host_at_step / slow_host_at_step"
+                " / lose_snapshot_at_step) require resilience.elastic.enabled"
+                " — without the elastic layer they would silently never fire"
+            )
+        if (
+            self.elastic.enabled
+            and self.chaos.enabled
+            and self.chaos.elastic_target_host >= self.elastic.n_virtual_hosts
+        ):
+            raise ValueError(
+                f"chaos.elastic_target_host {self.chaos.elastic_target_host} "
+                f"outside n_virtual_hosts={self.elastic.n_virtual_hosts}"
+            )
 
 
 @dataclass(frozen=True)

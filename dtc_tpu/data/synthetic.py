@@ -41,3 +41,46 @@ def synthetic_batch_iterator(
         tokens = np.where(copy_mask, shifted, tokens)
         yield tokens.astype(np.int32)
         i += 1
+
+
+def synthetic_row(seq_len: int, vocab_size: int, seed: int, row: int) -> np.ndarray:
+    """One deterministic ``(seq_len,)`` row, independently seeded by its
+    ROW index — the primitive of the batch-shape-independent stream below
+    (row ``r`` is identical whatever batch groups it)."""
+    rng = np.random.default_rng(np.random.SeedSequence([seed, 1, row]))
+    base = rng.zipf(1.3, size=(seq_len,)).astype(np.int64)
+    tokens = (base - 1) % vocab_size
+    copy_mask = rng.random((seq_len,)) < 0.5
+    shifted = np.roll(tokens, 8)
+    tokens = np.where(copy_mask, shifted, tokens)
+    return tokens.astype(np.int32)
+
+
+def synthetic_row_batches(
+    batch_size: int,
+    seq_len: int,
+    vocab_size: int,
+    seed: int = 0,
+    start_row: int = 0,
+) -> Iterator[np.ndarray]:
+    """Row-stream batching: the elastic-shrink data contract (ISSUE 15).
+
+    Unlike :func:`synthetic_batch_iterator` — whose batch ``i`` content
+    depends on the BATCH SHAPE (the whole batch is one RNG draw) — this
+    stream is a flat sequence of independently-seeded rows; a batch of
+    size ``B`` starting at row ``r`` consumes rows ``[r, r + B)``. Token
+    accounting is therefore batch-shape-independent: after consuming
+    ``T`` tokens at any batch size, ``start_row = T // (seq_len)`` resumes
+    the SAME flat row sequence at any other batch size — the property an
+    elastic resize that changes the global batch relies on to re-seek the
+    stream by global tokens-consumed (pinned in tests/test_data.py).
+    Elastic trainer runs (``resilience.elastic.enabled`` with
+    ``dataset: synthetic``) use this stream.
+    """
+    r = start_row
+    while True:
+        yield np.stack(
+            [synthetic_row(seq_len, vocab_size, seed, r + b)
+             for b in range(batch_size)]
+        )
+        r += batch_size

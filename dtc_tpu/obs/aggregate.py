@@ -133,6 +133,78 @@ def _serve_stats(events: list[dict[str, Any]]) -> dict[str, Any] | None:
     return out
 
 
+#: Elastic-training event types reduced into the ``elastic`` section
+#: (ISSUE 15) — recovery must show up in fleet summaries, not only in
+#: the raw shard.
+_ELASTIC_ETYPES = ("snapshot", "host_lost", "host_slow", "elastic_resize",
+                   "elastic_spill")
+
+
+def _elastic_stats(events: list[dict[str, Any]]) -> dict[str, Any] | None:
+    """Per-shard elastic reduction: hot-tier snapshot commit count (and
+    cadence skips), hosts declared lost/slow, and every resize with its
+    restore tier + surviving mesh. ``None`` when the shard holds no
+    elastic events (the common, non-elastic run)."""
+    snapshots = 0
+    skipped = 0
+    last_snapshot_step = None
+    incomplete = 0
+    lost: list[dict[str, Any]] = []
+    slow: list[dict[str, Any]] = []
+    resizes: list[dict[str, Any]] = []
+    spills = 0
+    for e in events:
+        et = e.get("etype")
+        if et not in _ELASTIC_ETYPES:
+            continue
+        if et == "snapshot":
+            snapshots += 1
+            if e.get("complete") is False:
+                incomplete += 1
+            else:
+                last_snapshot_step = e.get("step")
+            sk = e.get("skipped")
+            if isinstance(sk, (int, float)):
+                skipped = max(skipped, int(sk))
+        elif et == "host_lost":
+            lost.append({
+                "host": e.get("host"), "detected_at": e.get("detected_at"),
+                "escalated": bool(e.get("escalated")),
+            })
+        elif et == "host_slow":
+            slow.append({
+                "host": e.get("host"), "detected_at": e.get("detected_at"),
+            })
+        elif et == "elastic_resize":
+            resizes.append({
+                "step": e.get("step"), "to_step": e.get("to_step"),
+                "tier": e.get("tier"),
+                "used_mirror": bool(e.get("used_mirror")),
+                "devices": e.get("devices"),
+                "hosts_lost": e.get("hosts_lost"),
+            })
+        elif et == "elastic_spill":
+            spills += 1
+    if not (snapshots or lost or slow or resizes or spills):
+        return None
+    out: dict[str, Any] = {"snapshots": snapshots}
+    if skipped:
+        out["snapshot_skips"] = skipped
+    if incomplete:
+        out["snapshots_incomplete"] = incomplete
+    if last_snapshot_step is not None:
+        out["last_snapshot_step"] = last_snapshot_step
+    if lost:
+        out["hosts_lost"] = lost
+    if slow:
+        out["hosts_slow"] = slow
+    if resizes:
+        out["resizes"] = resizes
+    if spills:
+        out["spills"] = spills
+    return out
+
+
 def reduce_shards(
     obs_dir: str, straggler_threshold: float = 1.5
 ) -> dict[str, Any] | None:
@@ -167,6 +239,7 @@ def reduce_shards(
     shards = find_shards(obs_dir)
     per_host: dict[int, dict[int, float]] = {}
     serve_host: dict[int, dict[str, Any]] = {}
+    elastic_host: dict[int, dict[str, Any]] = {}
     for proc, path in sorted(shards.items()):
         events = read_jsonl(path)
         times = _step_times(events)
@@ -175,6 +248,27 @@ def reduce_shards(
         serve = _serve_stats(events)
         if serve is not None:
             serve_host[proc] = serve
+        elastic = _elastic_stats(events)
+        if elastic is not None:
+            elastic_host[proc] = elastic
+    elastic_total: dict[str, Any] | None = None
+    if elastic_host:
+        # Cross-shard merge: counters sum, event lists concatenate (each
+        # record already names its host), last_snapshot_step takes the max.
+        elastic_total = {"snapshots": 0}
+        for s in elastic_host.values():
+            elastic_total["snapshots"] += s.get("snapshots", 0)
+            for k in ("snapshot_skips", "snapshots_incomplete", "spills"):
+                if k in s:
+                    elastic_total[k] = elastic_total.get(k, 0) + s[k]
+            if "last_snapshot_step" in s:
+                elastic_total["last_snapshot_step"] = max(
+                    elastic_total.get("last_snapshot_step", -1),
+                    s["last_snapshot_step"],
+                )
+            for k in ("hosts_lost", "hosts_slow", "resizes"):
+                if k in s:
+                    elastic_total.setdefault(k, []).extend(s[k])
     serve_total = None
     if serve_host:
         from dtc_tpu.utils.percentile import nearest_rank, round_opt as r4
@@ -233,7 +327,7 @@ def reduce_shards(
             }
             for proc, s in serve_host.items()
         }
-        return {
+        out = {
             "hosts": hosts,
             "stragglers": [],
             "straggler_threshold": straggler_threshold,
@@ -241,6 +335,9 @@ def reduce_shards(
             "training_steps": 0,
             "serve": serve_total,
         }
+        if elastic_total is not None:
+            out["elastic"] = elastic_total
+        return out
 
     host_means = {
         proc: sum(t.values()) / len(t) for proc, t in per_host.items()
@@ -286,4 +383,6 @@ def reduce_shards(
     }
     if serve_total is not None:
         out["serve"] = serve_total
+    if elastic_total is not None:
+        out["elastic"] = elastic_total
     return out

@@ -425,6 +425,19 @@ class Telemetry:
         self.registry.counter("recoveries").inc()
         self.registry.emit("recovery", step=step, action=action, **fields)
 
+    def on_elastic(self, step: int, kind: str, **fields: Any) -> None:
+        """Typed elastic-layer events (ISSUE 15): ``host_lost`` /
+        ``host_slow`` / ``elastic_resize`` / ``elastic_spill`` land in
+        the JSONL stream (and from there the Perfetto instant set and
+        the cross-host reducer). A host loss additionally dumps the
+        flight recorder — the post-mortem starts from a timeline, not a
+        silent restart."""
+        name = kind if kind.startswith("elastic_") else f"elastic_{kind}"
+        self.registry.counter(name).inc()
+        self.registry.emit(kind, step=step, **fields)
+        if kind == "host_lost":
+            self.dump_flight("host_lost", step=step)
+
     def on_hung_step(self, step: int, **fields: Any) -> None:
         self.registry.counter("hung_steps").inc()
         self.registry.emit("hung_step", step=step, **fields)

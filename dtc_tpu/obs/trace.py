@@ -238,6 +238,11 @@ _INSTANT_ETYPES = frozenset({
     "router_route", "router_failover", "router_replica_state",
     "router_reject", "router_heartbeat_missed", "router_adapter_load",
     "router_drained",
+    # Elastic-training events (ISSUE 15): hot-tier snapshot commits and
+    # the host-loss -> resize -> cold-spill recovery chain, so a
+    # trace_report waterfall shows recovery where it happened.
+    "snapshot", "host_lost", "host_slow", "elastic_resize",
+    "elastic_spill",
 })
 
 

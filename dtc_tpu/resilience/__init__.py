@@ -11,6 +11,11 @@ traffic) treats recovery as a first-class subsystem, not an afterthought:
   (heals transient HF-streaming faults bit-exactly) + the generic
   elapsed-capped ``retry_call`` the serving runtime reuses;
 - :mod:`~dtc_tpu.resilience.watchdog` — hung-step flagging + hard timeout;
+- :mod:`~dtc_tpu.resilience.snapshot` — async in-memory snapshots with
+  peer-redundant (ring-mirrored) per-host shard stores — the hot recovery
+  tier (ISSUE 15);
+- :mod:`~dtc_tpu.resilience.elastic` — virtual hosts, heartbeat failure
+  detection, and shrink-mesh planning for elastic shrink-and-continue;
 - :mod:`~dtc_tpu.resilience.events` — thread-safe bus that feeds recovery
   actions into the telemetry stream;
 - :mod:`~dtc_tpu.resilience.errors` — the catchable failure taxonomy.
@@ -20,16 +25,24 @@ reference.
 """
 
 from dtc_tpu.resilience.chaos import ChaosInjector
+from dtc_tpu.resilience.elastic import HostMonitor, VirtualHosts, shrink_mesh
 from dtc_tpu.resilience.errors import (
     AnomalyAbort,
     ChaosInjectedError,
     DataStreamError,
+    ElasticAbort,
     ResilienceError,
+    SnapshotIncompleteError,
     WatchdogTimeout,
 )
 from dtc_tpu.resilience.events import RecoveryBus
 from dtc_tpu.resilience.guard import AnomalyGuard, GuardDecision
 from dtc_tpu.resilience.retry import resilient_iterator, retry_call
+from dtc_tpu.resilience.snapshot import (
+    InMemorySnapshot,
+    RedundancyPlan,
+    SnapshotStore,
+)
 from dtc_tpu.resilience.watchdog import StepWatchdog
 
 __all__ = [
@@ -38,11 +51,19 @@ __all__ = [
     "ChaosInjectedError",
     "ChaosInjector",
     "DataStreamError",
+    "ElasticAbort",
     "GuardDecision",
+    "HostMonitor",
+    "InMemorySnapshot",
     "RecoveryBus",
+    "RedundancyPlan",
     "ResilienceError",
+    "SnapshotIncompleteError",
+    "SnapshotStore",
     "StepWatchdog",
+    "VirtualHosts",
     "WatchdogTimeout",
     "resilient_iterator",
     "retry_call",
+    "shrink_mesh",
 ]

@@ -207,6 +207,54 @@ class ChaosInjector:
             return self.cfg.fleet_partition_iters
         return 0
 
+    # ---- elastic plane (dtc_tpu/resilience/elastic.py + snapshot.py,
+    # ISSUE 15 — step numbers are trainer loop steps; the trainer consults
+    # these each step so every fault lands on the production elastic
+    # paths: heartbeat detection, ring-mirror fallback, cold-tier
+    # verification) ----------------------------------------------------
+    def kill_host(self, step: int) -> int | None:
+        """Victim virtual host to kill at ``step`` (it stops heartbeating
+        forever; the monitor must detect it and the trainer must shrink
+        and continue from the in-memory snapshot). None = no fault."""
+        if step == self.cfg.kill_host_at_step and self._fire(
+            "kill_host", step=step, host=self.cfg.elastic_target_host
+        ):
+            return self.cfg.elastic_target_host
+        return None
+
+    def slow_host(self, step: int) -> tuple[int, int] | None:
+        """``(host, straggle_iters)`` when the victim host's heartbeats
+        start arriving late at ``step`` — the straggler case: the monitor
+        must flag ``host_slow`` and NOT declare it lost (straggle length
+        below ``heartbeat_miss_limit`` heals in place)."""
+        if step == self.cfg.slow_host_at_step and self._fire(
+            "slow_host", step=step, host=self.cfg.elastic_target_host,
+            iters=self.cfg.slow_host_iters,
+        ):
+            return self.cfg.elastic_target_host, self.cfg.slow_host_iters
+        return None
+
+    def lose_snapshot(self, step: int) -> int | None:
+        """Victim host whose PRIMARY in-memory snapshot copy vanishes at
+        ``step`` (host memory loss without host loss): the next restore
+        that needs its shards must fall back to the ring mirror."""
+        if step == self.cfg.lose_snapshot_at_step and self._fire(
+            "lose_snapshot", step=step, host=self.cfg.elastic_target_host
+        ):
+            return self.cfg.elastic_target_host
+        return None
+
+    def maybe_tear_cold_spill(self, step: int, step_dir: str) -> bool:
+        """Torn cold-tier spill: truncate the largest file of the
+        just-written cold (Orbax) checkpoint at ``step`` — a preemption
+        mid-spill. The verified-checkpoint fallback must reject the step
+        on the next restore instead of resuming from torn bytes."""
+        if step != self.cfg.torn_cold_spill_at_step or not self._fire(
+            "torn_cold_spill", step=step
+        ):
+            return False
+        return self._damage_dir(step_dir, "truncate")
+
     def maybe_corrupt_checkpoint(self, step: int, step_dir: str) -> bool:
         """After the checkpoint at ``step`` was fully written (manifest
         included): damage the largest file under its directory —
@@ -216,6 +264,12 @@ class ChaosInjector:
             "ckpt_corrupt", step=step, mode=self.cfg.corrupt_mode
         ):
             return False
+        return self._damage_dir(step_dir, self.cfg.corrupt_mode)
+
+    @staticmethod
+    def _damage_dir(step_dir: str, mode: str) -> bool:
+        """Damage the largest file under ``step_dir`` (shared by the
+        checkpoint-corruption and torn-cold-spill faults)."""
         target, size = None, -1
         for root, _, files in os.walk(step_dir):
             for name in files:
@@ -225,7 +279,7 @@ class ChaosInjector:
                     target, size = p, s
         if target is None:
             return False
-        if self.cfg.corrupt_mode == "truncate":
+        if mode == "truncate":
             with open(target, "r+b") as f:
                 f.truncate(size // 2)
         else:  # flip

@@ -1,0 +1,192 @@
+"""Elastic training: virtual hosts, failure detection, shrink planning.
+
+The reference paper (and PR 2's recovery story) assumes a fixed, healthy
+device mesh for the whole run; at pod scale, host loss is the steady
+state. This module supplies the pieces the trainer composes into
+shrink-and-continue (MegaScale / Gemini style):
+
+- :class:`VirtualHosts` — the in-process emulation of pod hosts: the N
+  devices are split into ``n_hosts`` contiguous groups, each "host"
+  owning its group plus an in-memory snapshot store
+  (``dtc_tpu.resilience.snapshot``). The same seam the serving fleet's
+  ``EngineReplica`` handles model (dtc_tpu/serve/replica.py): a real
+  multi-host deployment replaces the device-group bookkeeping with
+  process indices and the stores with a DCN transport; the trainer's
+  recovery logic is unchanged.
+
+  HONESTY: on CPU the "hosts" share one process and a killed host's
+  devices keep computing until detection (a real pod would hang in the
+  next collective — the watchdog hard-timeout path). What IS real:
+  detection runs on heartbeats alone (never by peeking at the kill
+  flag), recovery reads ONLY surviving hosts' stores, and the restored
+  trajectory is bit-checked against a snapshot-replay reference.
+
+- :class:`HostMonitor` — heartbeat failure detection layered on the
+  PR 2 watchdog: every live host beats each step; ``miss_limit``
+  consecutive missed beats declare the host lost (typed ``host_lost``).
+  A hung-step flag from the step watchdog counts as a collective-stall
+  signal and ESCALATES detection (one missed beat suffices) — the
+  "collective stalled, someone is gone" fast path. A host that beats
+  late (chaos ``slow_host_at_step``, a straggler) is flagged
+  ``host_slow`` exactly once and must NOT be declared lost.
+
+- :func:`shrink_mesh` — rebuild the mesh from the survivors' devices:
+  pipe/model axis sizes are preserved (elastic shrink removes whole
+  data-parallel groups), the data axis absorbs the survivors. Raises
+  :class:`ElasticAbort` when no valid smaller mesh exists (survivors
+  not divisible by the model axis, pipeline runs).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from dtc_tpu.resilience.errors import ElasticAbort
+
+
+class VirtualHosts:
+    """``n_hosts`` contiguous device groups over the process's devices."""
+
+    def __init__(self, n_hosts: int, devices: list | None = None):
+        import jax
+
+        devices = list(devices if devices is not None else jax.devices())
+        if n_hosts < 2:
+            raise ValueError(f"n_virtual_hosts must be >= 2, got {n_hosts}")
+        if len(devices) % n_hosts != 0:
+            raise ValueError(
+                f"{len(devices)} devices do not split into {n_hosts} "
+                "equal virtual hosts"
+            )
+        self.n_hosts = n_hosts
+        self.devices = sorted(devices, key=lambda d: d.id)
+        self.per_host = len(devices) // n_hosts
+        self._host_of = {
+            d.id: i // self.per_host for i, d in enumerate(self.devices)
+        }
+        self.alive: set[int] = set(range(n_hosts))
+
+    def host_of(self, device: Any) -> int:
+        return self._host_of[device.id]
+
+    def devices_of(self, host: int) -> list:
+        return self.devices[host * self.per_host:(host + 1) * self.per_host]
+
+    def survivor_devices(self) -> list:
+        return [d for d in self.devices if self._host_of[d.id] in self.alive]
+
+    def kill(self, host: int) -> None:
+        self.alive.discard(host)
+
+    def ring_next(self, host: int) -> int:
+        return (host + 1) % self.n_hosts
+
+
+class HostMonitor:
+    """Heartbeat + collective-stall failure detection over virtual hosts.
+
+    ``tick(step)`` records a beat for every host that is actually alive
+    (and not mid-straggle); ``poll(step)`` judges by the BEAT HISTORY
+    alone — detection never consults the emulation's kill flag, so the
+    detector is the same code a real heartbeat transport would drive.
+    """
+
+    def __init__(self, hosts: VirtualHosts, *, miss_limit: int = 2):
+        self.hosts = hosts
+        self.miss_limit = max(int(miss_limit), 1)
+        # Roster frozen at CONSTRUCTION (after ``elastic.dead_hosts`` was
+        # applied, before any chaos fires): a shrunk RESTART must not
+        # "detect" its already-gone hosts, but a host the chaos kills
+        # before the first tick — the trainer applies kills ahead of the
+        # tick in the same iteration — must still be monitored, or a
+        # kill_host_at_step on the first step is never detected at all.
+        self._roster = sorted(hosts.alive)
+        self._last_beat: dict[int, int] = {}
+        self._slow_until: dict[int, int] = {}
+        self._lost: set[int] = set()
+        self._slow_flagged: set[int] = set()
+        self._started_at: int | None = None
+
+    def mark_slow(self, host: int, until_step: int) -> None:
+        """Chaos ``slow_host_at_step``: ``host`` beats late (no beats
+        through ``until_step``) — straggler fodder for ``poll``."""
+        self._slow_until[host] = max(self._slow_until.get(host, 0), until_step)
+
+    def tick(self, step: int) -> None:
+        if self._started_at is None:
+            # Seed beats for the construction-time roster, NOT the
+            # current alive set: a host killed between construction and
+            # the first tick must enter the beat table (and then miss
+            # every beat) to be detectable.
+            self._started_at = step - 1
+            for h in self._roster:
+                self._last_beat[h] = step - 1
+        for h in self.hosts.alive:
+            if self._slow_until.get(h, 0) >= step:
+                continue  # straggling: the beat does not arrive this step
+            self._last_beat[h] = step
+
+    def poll(self, step: int, *, stalled: bool = False) -> list[dict]:
+        """Typed detection events for this step.
+
+        ``stalled`` — the step watchdog flagged the current step as hung
+        (a wedged collective): escalate, any host already missing a beat
+        is declared lost immediately instead of waiting out
+        ``miss_limit``. Each host is reported lost (or slow) exactly
+        once."""
+        events: list[dict] = []
+        if self._started_at is None:
+            return events
+        limit = 1 if stalled else self.miss_limit
+        for h in sorted(self._last_beat):
+            if h in self._lost:
+                continue
+            missed = step - self._last_beat[h]
+            if missed >= limit:
+                self._lost.add(h)
+                events.append({
+                    "kind": "host_lost", "host": h, "missed": missed,
+                    "last_beat": self._last_beat[h], "detected_at": step,
+                    "escalated": bool(stalled),
+                })
+            elif missed >= 1 and h not in self._slow_flagged:
+                self._slow_flagged.add(h)
+                events.append({
+                    "kind": "host_slow", "host": h, "missed": missed,
+                    "last_beat": self._last_beat[h], "detected_at": step,
+                })
+        return events
+
+    @property
+    def lost(self) -> set[int]:
+        return set(self._lost)
+
+
+def shrink_mesh(mesh: Any, hosts: VirtualHosts) -> Any:
+    """Rebuild the mesh over the surviving hosts' devices.
+
+    Shrink happens along the "data" axis only (whole DP/FSDP groups
+    leave); "model" (TP) groups must stay intact — a lost host that
+    takes part of every TP group with it leaves no valid smaller mesh.
+    """
+    from dtc_tpu.parallel.mesh import build_mesh
+
+    survivors = hosts.survivor_devices()
+    if not survivors:
+        raise ElasticAbort("no surviving hosts to rebuild a mesh from")
+    shape = dict(mesh.shape)
+    pipe = int(shape.get("pipe", 1))
+    model = int(shape.get("model", 1))
+    if pipe > 1:
+        raise ElasticAbort(
+            "elastic shrink is not supported under pipeline parallelism "
+            "(stage-chunked params cannot re-shard onto fewer stages); "
+            "use a mesh with pipe == 1"
+        )
+    if len(survivors) % model != 0:
+        raise ElasticAbort(
+            f"{len(survivors)} surviving devices do not preserve the "
+            f"model={model} (TP) axis; no valid shrunk mesh exists"
+        )
+    new_data = len(survivors) // model
+    return build_mesh((1, new_data, model), devices=survivors)

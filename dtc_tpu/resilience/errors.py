@@ -31,6 +31,20 @@ class WatchdogTimeout(ResilienceError):
     """A training step exceeded the watchdog's hard timeout."""
 
 
+class SnapshotIncompleteError(ResilienceError):
+    """An in-memory snapshot cannot be reconstructed from the surviving
+    hosts' stores (a needed shard's primary owner and ring mirror are
+    both gone, or every surviving copy fails its integrity hash). The
+    trainer falls back to the cold (disk) tier."""
+
+
+class ElasticAbort(ResilienceError):
+    """Elastic recovery is impossible: no valid smaller mesh exists for
+    the survivors (TP groups broken, pipeline runs), or the batch cannot
+    shard over the shrunk data axis. A supervisor must restart the job
+    on a reprovisioned slice from the last cold-tier checkpoint."""
+
+
 class ChaosInjectedError(ConnectionError):
     """Deterministic fault raised by the chaos harness into the data plane.
 

@@ -19,7 +19,9 @@ The policy ladder (MegaScale-style, cheapest rung first):
    ``max_consecutive_skips`` consecutive bad windows before escalating.
 2. **rollback** — restore the last *verified* checkpoint and re-seek the
    data stream (the trainer owns the mechanics); at most ``max_rollbacks``
-   per run.
+   per run — or per *incident* when ``clean_steps_to_forgive`` is set:
+   that many consecutive healthy log windows reset the counter, so
+   well-separated transients on a long run never exhaust the ladder.
 3. **abort** — raise :class:`AnomalyAbort` so a supervisor restarts the
    job from the last good checkpoint instead of burning accelerator time
    on a diverged run.
@@ -53,6 +55,12 @@ class AnomalyGuard:
         self.can_rollback = can_rollback
         self.rollbacks_done = 0
         self._consecutive_bad = 0
+        # Consecutive healthy windows since the last anomaly — drives the
+        # forgiveness knob (clean_steps_to_forgive): a long-enough clean
+        # streak resets the rollback counter, so max_rollbacks bounds
+        # rollbacks per INCIDENT instead of per run lifetime (a week-long
+        # run used to die on its Nth well-separated transient).
+        self._clean_windows = 0
         # Trailing window means of HEALTHY windows only — an anomaly must
         # not drag the median toward itself.
         self._means: deque[float] = deque(maxlen=max(int(cfg.spike_window), 2))
@@ -99,7 +107,17 @@ class AnomalyGuard:
         if reason is None:
             self._consecutive_bad = 0
             self._means.append(sum(losses) / len(losses))
+            self._clean_windows += 1
+            forgive = int(self.cfg.clean_steps_to_forgive)
+            if (
+                forgive > 0
+                and self.rollbacks_done > 0
+                and self._clean_windows >= forgive
+            ):
+                self.rollbacks_done = 0
+                self._clean_windows = 0
             return GuardDecision("ok")
+        self._clean_windows = 0
         self._consecutive_bad += 1
         if (
             self.cfg.skip_nonfinite_updates

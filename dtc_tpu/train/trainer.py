@@ -20,6 +20,7 @@ windows, and multi-host feeding.
 
 from __future__ import annotations
 
+import contextlib
 import os
 import threading
 import time
@@ -36,7 +37,7 @@ from jax.sharding import PartitionSpec as P
 
 from dtc_tpu.config.schema import ModelConfig, OptimConfig, TrainConfig
 from dtc_tpu.data.prefetch import ShardedPrefetchIterator
-from dtc_tpu.data.synthetic import synthetic_batch_iterator
+from dtc_tpu.data.synthetic import synthetic_batch_iterator, synthetic_row_batches
 from dtc_tpu.models.gpt import GPT
 from dtc_tpu.parallel.mesh import mesh_from_config
 from dtc_tpu.parallel.pipeline import pp_param_specs, pp_stack_params
@@ -102,6 +103,7 @@ def make_host_iterator(
     chaos=None,
     on_recovery=None,
     cancel=None,
+    row_stream: bool = False,
 ) -> Iterator[np.ndarray]:
     """(batch, seq_len+1) token batches; per-process share in multi-host runs.
 
@@ -121,6 +123,15 @@ def make_host_iterator(
     if train_cfg.dataset == "synthetic":
         # Offset multi-host streams so processes contribute distinct data.
         seed = train_cfg.seed * 1000 + seed_offset + jax.process_index()
+        if row_stream:
+            # Elastic runs (ISSUE 15): the flat row stream whose token
+            # accounting is batch-shape-independent, so a resize that
+            # changes the batch geometry re-seeks by rows consumed —
+            # ``skip_batches`` converts at THIS call's batch size.
+            return synthetic_row_batches(
+                batch, seq, model_cfg.vocab_size, seed=seed,
+                start_row=skip_batches * batch,
+            )
         return synthetic_batch_iterator(
             batch, seq, model_cfg.vocab_size, seed=seed, start=skip_batches
         )
@@ -168,6 +179,21 @@ def _placed_gspmd_params(params: PyTree, mesh: Mesh, rules) -> PyTree:
         lambda s: NamedSharding(mesh, s), specs, is_leaf=lambda x: isinstance(x, P)
     )
     return jax.device_put(params, shardings)
+
+
+def _reshard_onto(tree: PyTree, mesh: Mesh) -> PyTree:
+    """Re-place every array leaf of ``tree`` on ``mesh``, keeping its
+    PartitionSpec axis NAMES (sizes re-resolve against the new mesh) —
+    the cold-tier leg of an elastic resize, where the restored state's
+    arrays still live on the pre-shrink device set."""
+    def leaf(a: Any) -> Any:
+        if not isinstance(a, jax.Array):
+            return a
+        spec = getattr(a.sharding, "spec", None)
+        spec = normalize_spec(spec if spec is not None else P(), mesh)
+        return jax.device_put(np.asarray(a), NamedSharding(mesh, spec))
+
+    return jax.tree.map(leaf, tree)
 
 
 def _guarded_optimizer(train_cfg: TrainConfig, opt_cfg: OptimConfig):
@@ -333,6 +359,56 @@ def _train(
             # off it. Ulysses re-shards heads over "model" INSIDE the
             # attention op only.
             rules = ring_rules_from(rules)
+
+    # ------ elastic training (ISSUE 15): virtual hosts + shrunk restart --
+    # The device set splits into n_virtual_hosts contiguous "hosts" (the
+    # in-process emulation of pod hosts — see resilience/elastic.py for
+    # the honesty note); a host named dead at STARTUP shrinks the mesh
+    # before anything is placed, so a post-failure restart comes up
+    # directly on the survivors — the same path the in-run resize takes,
+    # minus the detection.
+    el_cfg = train_cfg.resilience.elastic
+    el_on = el_cfg.enabled
+    hosts = None
+    if el_on:
+        from dtc_tpu.resilience.elastic import VirtualHosts, shrink_mesh
+
+        if jax.process_count() > 1:
+            raise ValueError(
+                "resilience.elastic emulates hosts in-process; real "
+                "multi-process runs are not supported yet (the virtual-"
+                "host seam is where a DCN transport would slot in)"
+            )
+        if train_cfg.dataset != "synthetic" or host_iterator is not None:
+            raise ValueError(
+                "resilience.elastic requires dataset: synthetic (the "
+                "batch-shape-independent row stream is the re-seek "
+                "contract); fineweb and caller-provided iterators cannot "
+                "be re-positioned across a mesh resize"
+            )
+        if mesh.shape.get("pipe", 1) > 1:
+            raise ValueError(
+                "resilience.elastic does not support pipeline parallelism "
+                "(stage-chunked params cannot re-shard onto fewer stages); "
+                "use a mesh with pipe == 1"
+            )
+        if model_cfg.adapter.rank > 0:
+            raise ValueError(
+                "resilience.elastic does not support LoRA finetunes: the "
+                "frozen base params are outside the snapshotted TrainState"
+            )
+        hosts = VirtualHosts(el_cfg.n_virtual_hosts)
+        for h in el_cfg.dead_hosts:
+            hosts.kill(h)
+        if el_cfg.dead_hosts:
+            mesh = shrink_mesh(mesh, hosts)
+            num_devices = len(hosts.survivor_devices())
+        if train_cfg.batch % int(mesh.shape["data"]) != 0:
+            raise ValueError(
+                f"global batch {train_cfg.batch} must shard over the data "
+                f"axis {int(mesh.shape['data'])} (elastic preserves the "
+                "global batch and rescales the per-device batch)"
+            )
     lead = is_lead_process()
     if lead:
         print(
@@ -383,6 +459,18 @@ def _train(
     res_cfg = train_cfg.resilience
     bus = RecoveryBus()
     chaos = ChaosInjector(res_cfg.chaos, bus) if res_cfg.chaos.enabled else None
+    # Elastic detection + hot tier (ISSUE 15). Snapshot commits happen on
+    # a worker thread, so their events ride the bus like every other
+    # off-thread recovery source.
+    monitor = None
+    snap_store = None
+    if el_on:
+        from dtc_tpu.resilience import HostMonitor, SnapshotStore
+
+        monitor = HostMonitor(hosts, miss_limit=el_cfg.heartbeat_miss_limit)
+        snap_store = SnapshotStore(
+            hosts, keep=el_cfg.keep, on_event=bus.post
+        )
     if chaos is not None and (
         res_cfg.chaos.data_error_at_doc or res_cfg.chaos.data_stall_at_doc
     ) and not (train_cfg.dataset == "fineweb" and host_iterator is None):
@@ -396,6 +484,11 @@ def _train(
         )
 
     with mesh, nn.logical_axis_rules(rules):
+        # An elastic resize swaps the ambient mesh mid-run: the survivor
+        # mesh is ENTERED onto this stack (nested inside the enclosing
+        # ``with mesh``) and closed in the finally below, so the context
+        # unwind stays LIFO even after one or more shrinks.
+        resize_ctx = contextlib.ExitStack()
         if model_cfg.collectives == "overlapped" and lead:
             from dtc_tpu.parallel.sharding import fsdp_axis_in_scope
 
@@ -417,6 +510,13 @@ def _train(
             state = init_state(model, model_cfg, train_cfg, opt_cfg, mesh, rules)
 
         # ------ checkpoint / resume ------
+        # With elastic on, the disk checkpoint is DEMOTED to the cold /
+        # catastrophic tier: the in-memory snapshots are the hot recovery
+        # path, so ``elastic.cold_every`` (when set) slows the Orbax
+        # cadence without touching the TrainConfig knob.
+        checkpoint_every_eff = train_cfg.checkpoint_every
+        if el_on and el_cfg.cold_every > 0 and train_cfg.checkpoint_every > 0:
+            checkpoint_every_eff = el_cfg.cold_every
         ckpt = None
         start_step = 0
         if train_cfg.checkpoint_every > 0:
@@ -426,7 +526,8 @@ def _train(
                 train_cfg.output_dir, "checkpoints"
             )
             ckpt = CheckpointManager(
-                ckpt_dir, verify=res_cfg.verify_checkpoints, on_event=bus.post
+                ckpt_dir, verify=res_cfg.verify_checkpoints, on_event=bus.post,
+                keep_n=res_cfg.checkpoint_keep_n,
             )
             # Gate on EXISTENCE only (all_steps) — restore_latest does the
             # single integrity verification; a latest_step() here would
@@ -540,7 +641,7 @@ def _train(
                 return
             if not fineweb:
                 host_it = make_host_iterator(
-                    train_cfg, model_cfg, skip_batches=skip
+                    train_cfg, model_cfg, skip_batches=skip, row_stream=el_on
                 )
                 return
             sidecar = (
@@ -745,31 +846,106 @@ def _train(
                 tele.close()
                 raise
 
+        def commit_and_truncate(
+            target: int,
+            window_rows: list[tuple[int, float]],
+            window_losses: list[float],
+        ) -> None:
+            """Shared recovery bookkeeping (rollback AND elastic resize):
+            COMMIT the detection window's prefix at or before the restored
+            step (those steps will not be replayed — e.g. a target at 10
+            inside a 9..16 window must still log 9 and 10), then drop the
+            poisoned suffix from the in-memory results; the replayed
+            steps re-append (and re-log) from the restored step.
+            result_base is the step the lists currently start AFTER —
+            start_step originally, but a recovery below the resume point
+            moves it down, and a later truncation must count from where
+            the lists now begin."""
+            nonlocal result_base
+            for (s, el), lo in zip(window_rows, window_losses):
+                if s <= target:  # not replayed: commit now or lose it
+                    result.losses.append(lo)
+                    tele.emit_train_row(s, el, lo)
+            keep = max(target - result_base, 0)
+            del result.losses[keep:]
+            del result.elapsed_times[keep:]
+            result.eval_losses[:] = [
+                e for e in result.eval_losses if e[0] <= target
+            ]
+            result_base = min(result_base, target)
+
+        def restore_from_tiers(
+            cur_step: int, max_step: int | None, target_mesh: Mesh
+        ) -> tuple[PyTree | None, int | None, str, bool]:
+            """Two-tier restore-source selection, shared by the guard
+            rollback and the elastic resize so the two recoveries cannot
+            drift: the newest COMPLETE in-memory snapshot at or before
+            ``max_step`` (restored onto ``target_mesh`` via fresh
+            NamedShardings), else the newest VERIFIED cold checkpoint
+            (resharded only when the mesh actually changed). Returns
+            ``(state, step, tier, used_mirror)`` — state None when no
+            source exists; the callers decide whether that is a warning
+            (rollback) or fatal (resize)."""
+            if snap_store is not None:
+                snap_store.drain()
+                snap = snap_store.latest(max_step=max_step)
+                if snap is not None:
+                    from dtc_tpu.resilience import SnapshotIncompleteError
+
+                    try:
+                        restored, used_mirror = snap_store.restore(
+                            snap, hosts.alive, target_mesh
+                        )
+                        return restored, snap.step, "memory", used_mirror
+                    except SnapshotIncompleteError as e:
+                        tele.on_recovery(
+                            cur_step, action="snapshot_incomplete",
+                            reason=str(e),
+                        )
+            if ckpt is None:
+                return None, None, "cold", False
+            try:
+                state_cold, target = ckpt.restore_latest(state)
+            except FileNotFoundError:
+                return None, None, "cold", False
+            if target_mesh is not mesh:
+                state_cold = _reshard_onto(state_cold, target_mesh)
+            return state_cold, target, "cold", False
+
         def do_rollback(
             cur_step: int,
             reason: str,
             window_losses: list[float],
             window_rows: list[tuple[int, float]],
         ) -> int | None:
-            """Guard ladder rung 2: restore the newest VERIFIED checkpoint,
-            re-seek the data stream via its position sidecar, and return
-            the restored step (the loop resumes from there). None when no
-            intact checkpoint exists yet (the guard then only warns).
+            """Guard ladder rung 2: restore pre-anomaly state and re-seek
+            the data stream, returning the restored step (the loop
+            resumes from there). None when no restore source exists yet
+            (the guard then only warns).
 
-            ``window_losses``/``window_rows`` are the detection window's
-            fetched-but-uncommitted entries: the prefix at or before the
-            restored step is COMMITTED (those steps will not be replayed —
-            e.g. checkpoint at 10 inside a 9..16 window rolling back to 10
-            must still log 9 and 10), the poisoned suffix is discarded and
-            replayed. Steps already logged between the restored step and
-            the anomaly re-log on replay (CSV gets both rows; the JSONL
-            stream is the durable, annotated history)."""
-            nonlocal state, data_it, result_base
-            if ckpt is None:
-                return None
-            try:
-                state_rb, target = ckpt.restore_latest(state)
-            except FileNotFoundError:
+            Restore source order: the newest COMPLETE in-memory snapshot
+            STRICTLY before the window's last healthy boundary (elastic
+            hot tier — with the cold cadence demoted via ``cold_every``,
+            the disk checkpoint alone would lose up to cold_every steps
+            to a NaN), then the newest VERIFIED disk checkpoint. The
+            bound keeps never-validated state out of reach: snapshots
+            inside the anomalous window, and the one AT the boundary
+            itself, whose update no observed loss has vouched for (see
+            the comment at the ``latest`` call)."""
+            nonlocal state, data_it
+            # A step's loss is computed on the params going INTO it
+            # (value_and_grad before the update), so the previous
+            # window's healthy losses — through step `boundary` —
+            # validate snapshots only through boundary-1: the snapshot
+            # AT the boundary holds that step's never-validated update
+            # (an anomaly born there first shows at boundary+1, inside
+            # the poisoned window, and restoring it would replay
+            # straight back into it).
+            boundary = cur_step - len(window_losses)
+            state_rb, target, tier, _ = restore_from_tiers(
+                cur_step, boundary - 1, mesh
+            )
+            if state_rb is None:
                 return None  # nothing intact yet: the guard only warns
             # Re-commit stray scalar leaves to the mesh so the restored
             # state's input signature matches the compiled step executable
@@ -782,26 +958,10 @@ def _train(
                 host_it, mesh, batch_spec(rules), queue_size=train_cfg.prefetch
             )
             guard.note_rollback()
-            for (s, el), lo in zip(window_rows, window_losses):
-                if s <= target:  # not replayed: commit now or lose it
-                    result.losses.append(lo)
-                    tele.emit_train_row(s, el, lo)
-            # Drop the poisoned suffix from the in-memory results; the
-            # replayed steps re-append (and re-log) from the restored step.
-            # result_base is the step the lists currently start AFTER —
-            # start_step originally, but a rollback below the resume point
-            # (all post-resume checkpoints rejected) moves it down, and a
-            # later truncation must count from where the lists now begin.
-            keep = max(target - result_base, 0)
-            del result.losses[keep:]
-            del result.elapsed_times[keep:]
-            result.eval_losses[:] = [
-                e for e in result.eval_losses if e[0] <= target
-            ]
-            result_base = min(result_base, target)
+            commit_and_truncate(target, window_rows, window_losses)
             tele.on_recovery(
                 cur_step, action="rollback", to_step=target, reason=reason,
-                rollbacks=guard.rollbacks_done,
+                tier=tier, rollbacks=guard.rollbacks_done,
             )
             tele.drain_recovery_bus(bus, cur_step)
             # The restore's host transfers may compile tiny executables —
@@ -810,9 +970,133 @@ def _train(
             tele.flush()
             if lead:
                 print(
-                    f"[dtc_tpu] ROLLBACK: {reason} — restored verified "
-                    f"checkpoint step {target}, stream re-seeked "
+                    f"[dtc_tpu] ROLLBACK: {reason} — restored {tier} "
+                    f"snapshot step {target}, stream re-seeked "
                     f"({guard.rollbacks_done}/{res_cfg.guard.max_rollbacks})"
+                )
+            return target
+
+        def do_elastic_resize(
+            cur_step: int,
+            lost: list[int],
+            window_device_losses: list[jax.Array],
+            window_rows: list[tuple[int, float]],
+        ) -> int:
+            """Shrink-and-continue (ISSUE 15): rebuild a smaller mesh from
+            the surviving hosts, restore the newest complete in-memory
+            snapshot onto it (cold tier as fallback when the peers cannot
+            reconstruct), re-seek the row stream by tokens consumed, and
+            return the restored step — the loop replays from there. The
+            global batch is preserved; the per-device batch rescales.
+
+            Everything here runs OUTSIDE the hot path (a host just died);
+            the host syncs below are the recovery's, not the loop's."""
+            nonlocal state, data_it, mesh, train_step, num_devices
+            nonlocal result_base, eval_fn, eval_set, snap_dispatch_cold
+            from dtc_tpu.resilience.elastic import shrink_mesh
+            from dtc_tpu.resilience.errors import ElasticAbort
+
+            new_mesh = shrink_mesh(mesh, hosts)
+            new_data = int(new_mesh.shape["data"])
+            if train_cfg.batch % new_data != 0:
+                raise ElasticAbort(
+                    f"global batch {train_cfg.batch} does not shard over "
+                    f"the shrunk data axis {new_data}; no valid elastic "
+                    "continuation exists"
+                )
+            # Restore source: newest COMPLETE hot-tier snapshot; the cold
+            # (disk) tier only when the survivors cannot reconstruct it.
+            restored, target, tier, used_mirror = restore_from_tiers(
+                cur_step, None, new_mesh
+            )
+            if restored is None:
+                raise ElasticAbort(
+                    "no complete in-memory snapshot survives hosts "
+                    f"{sorted(lost)} being lost and no intact cold-tier "
+                    "checkpoint; elastic recovery is impossible — "
+                    "restart from a reprovisioned slice"
+                )
+            # The window's losses are still on-device mid-window (unlike
+            # do_rollback, which runs at a boundary with them fetched) —
+            # fetch, then share the rollback's commit/truncate contract.
+            fetched = [
+                float(v)
+                for v in jax.device_get(jnp.stack(window_device_losses))
+            ] if window_device_losses else []
+            commit_and_truncate(target, window_rows, fetched)
+            # Swap the mesh and rebuild everything mesh-shaped. The ONE
+            # new train-step executable this costs is asserted by the
+            # elastic tests (exactly one recompile event, at the first
+            # replayed step — not excused, counted).
+            resize_ctx.enter_context(new_mesh)
+            mesh = new_mesh
+            num_devices = len(hosts.survivor_devices())
+            state = canonicalize_state_placement(restored, mesh)
+            train_step = create_train_step(
+                mesh, model=model,
+                num_microbatches=train_cfg.pp_microbatches, rules=rules,
+                pp_schedule=train_cfg.pp_schedule,
+                pp_virtual=train_cfg.pp_virtual_stages, state=state,
+                base_params=None,
+            )
+            stream_cancel.set()
+            data_it.close()
+            build_data(target)
+            data_it = ShardedPrefetchIterator(
+                host_it, mesh, batch_spec(rules),
+                queue_size=train_cfg.prefetch,
+            )
+            if eval_fn is not None:
+                # Eval state is mesh-shaped too: rebuild the step and
+                # re-place the (deterministic, synthetic) eval batches.
+                from dtc_tpu.data.prefetch import split_put
+                from dtc_tpu.train.train_step import create_eval_step
+
+                eval_fn = create_eval_step(mesh, model, rules=rules)
+                spec = batch_spec(rules)
+                eval_it = make_eval_iterator(train_cfg, model_cfg)
+                eval_set = [
+                    split_put(next(eval_it), mesh, spec)
+                    for _ in range(train_cfg.eval_batches)
+                ]
+            tele.on_elastic(
+                cur_step, "elastic_resize", to_step=target, tier=tier,
+                used_mirror=used_mirror, hosts_lost=sorted(lost),
+                devices=num_devices,
+                mesh={k: int(v) for k, v in mesh.shape.items()},
+                per_device_batch=train_cfg.batch // new_data,
+            )
+            tele.drain_recovery_bus(bus, cur_step)
+            # Spill the restored state to the cold tier immediately: a
+            # second failure before the next cold save would otherwise be
+            # unrecoverable, and a shrunk RESTART (elastic.dead_hosts)
+            # resumes from exactly this step.
+            if ckpt is not None and el_cfg.spill_on_resize:
+                with tele.span("elastic_spill", step=target):
+                    ckpt.save(target, state)
+                sidecar_out = stream_position_sidecar(target)
+                if sidecar_out is not None:
+                    ckpt.save_stream(target, sidecar_out, jax.process_index())
+                if chaos is not None:
+                    # Torn spill: a preemption mid-write — the verified-
+                    # checkpoint fallback must reject it on restore.
+                    chaos.maybe_tear_cold_spill(target, ckpt.step_dir(target))
+                tele.on_elastic(target, "elastic_spill", detected_at=cur_step)
+            # The restore's host transfers / loss-stack fetch compile tiny
+            # executables — attribute them to the resize, so the first
+            # replayed step shows only the one real train-step recompile.
+            # The NEW mesh also recompiles the snapshot copy executables
+            # at the next dispatch; re-arm that tick's attribution.
+            snap_dispatch_cold = True
+            tele.record_aux_compile(cur_step, "elastic_resize")
+            tele.flush()
+            if lead:
+                print(
+                    f"[dtc_tpu] ELASTIC RESIZE: hosts {sorted(lost)} lost "
+                    f"— restored {tier} snapshot step {target}"
+                    f"{' (ring mirror)' if used_mirror else ''}, mesh -> "
+                    f"{dict(mesh.shape)}, per-device batch "
+                    f"{train_cfg.batch // new_data}, continuing"
                 )
             return target
 
@@ -909,6 +1193,12 @@ def _train(
                 print("Start measuring")
             device_losses: list[jax.Array] = []
             pending_rows: list[tuple[int, float]] = []
+            # The snapshot dispatch's per-leaf copy executables compile on
+            # the FIRST begin() for a given mesh; attribute that one tick
+            # (and only it — blanket attribution every snapshot_every
+            # steps would mask genuine train-step recompiles, the exact
+            # signal the watcher exists for).
+            snap_dispatch_cold = True
             window_start = time.perf_counter()
             window_steps = 0
             start_time = time.perf_counter()
@@ -960,13 +1250,65 @@ def _train(
                 breakdown = tele.on_step_end(
                     step, elapsed_s=now - start_time, synced=bool(sync_every_step)
                 )
+                stalled_flag = False
                 if wd is not None:
                     flag = wd.observe(step, breakdown["step_time_s"])
                     if flag is not None:
                         tele.on_hung_step(**flag)
+                        # A hung step is the collective-stall signal: the
+                        # heartbeat poll below escalates (one missed beat
+                        # then declares the host lost).
+                        stalled_flag = True
                         if res_cfg.watchdog.profile_on_flag:
                             tele.arm_profile_window(step + 1)
                 window_steps += 1
+
+                if el_on:
+                    # Emulation-side chaos lands BEFORE the heartbeat tick
+                    # and the snapshot cadence: a host killed at step k
+                    # contributes no beat and no stored shards from k on,
+                    # so the last COMPLETE snapshot is k-1 — that is the
+                    # <=1-step-lost-work bound the acceptance test pins.
+                    if chaos is not None:
+                        victim = chaos.kill_host(step)
+                        if victim is not None:
+                            hosts.kill(victim)
+                        slow = chaos.slow_host(step)
+                        if slow is not None:
+                            monitor.mark_slow(slow[0], step + slow[1] - 1)
+                        gone = chaos.lose_snapshot(step)
+                        if gone is not None:
+                            snap_store.drop_primary(gone)
+                    monitor.tick(step)
+                    if step % el_cfg.snapshot_every == 0:
+                        # Async + double-buffered: device-side copies and
+                        # a host transfer are DISPATCHED here; hashing and
+                        # filing happen on the commit thread. No host
+                        # sync on this path (hostsync lint covers it).
+                        if snap_store.begin(step, state) and snap_dispatch_cold:
+                            snap_dispatch_cold = False
+                            tele.record_aux_compile(step, "snapshot_dispatch")
+                    lost_now: list[int] = []
+                    for ev in monitor.poll(step, stalled=stalled_flag):
+                        kind = ev.pop("kind")
+                        tele.on_elastic(step, kind, **ev)
+                        if kind == "host_lost":
+                            lost_now.append(ev["host"])
+                    if lost_now:
+                        target = do_elastic_resize(
+                            step, lost_now, device_losses, pending_rows
+                        )
+                        # Replay from the restored step on the survivor
+                        # mesh; the detection window's suffix was
+                        # discarded by the resize (no rows, no eval, no
+                        # checkpoint from it).
+                        step = target
+                        device_losses, pending_rows = [], []
+                        window_start = time.perf_counter()
+                        window_steps = 0
+                        if wd is not None:
+                            wd.disarm()
+                        continue
 
                 if chaos is not None and chaos.should_preempt(step):
                     if in_main_thread:
@@ -1114,7 +1456,7 @@ def _train(
                     window_start = time.perf_counter()
                     window_steps = 0
 
-                if ckpt and (step % train_cfg.checkpoint_every == 0 or stopping):
+                if ckpt and (step % checkpoint_every_eff == 0 or stopping):
                     # Health-gate the save: between anomaly onset and the
                     # next log boundary the state may already be poisoned
                     # (NaN, or a finite spike in spike mode), and a
@@ -1152,6 +1494,11 @@ def _train(
                             chaos.maybe_corrupt_checkpoint(
                                 step, ckpt.step_dir(step)
                             )
+                            # Torn cold-tier spill (ISSUE 15): truncated
+                            # mid-write, rejected by the manifest check.
+                            chaos.maybe_tear_cold_spill(
+                                step, ckpt.step_dir(step)
+                            )
                     tele.record_aux_compile(step, "checkpoint")
 
                 if wd is not None:
@@ -1183,6 +1530,13 @@ def _train(
         finally:
             if wd is not None:
                 wd.stop()
+            if snap_store is not None:
+                snap_store.close()
+            # Unwind any survivor-mesh contexts entered by elastic resizes
+            # BEFORE the enclosing ``with mesh`` exits (LIFO); the `mesh`
+            # variable keeps pointing at the final mesh for run-end
+            # reporting.
+            resize_ctx.close()
             # Stop the prefetch worker (rollback may have already swapped
             # it once; close is idempotent) so no thread outlives the run.
             try:
@@ -1222,4 +1576,5 @@ def _train(
             ckpt.wait()
             ckpt.close()
         result.state = state
+        result.mesh = mesh  # an elastic resize swapped it mid-run
         return result

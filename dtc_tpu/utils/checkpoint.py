@@ -102,12 +102,16 @@ class CheckpointManager:
         *,
         verify: bool = True,
         on_event: Callable[..., None] | None = None,
+        keep_n: int = 3,
     ):
         import orbax.checkpoint as ocp
 
+        if keep_n < 1:
+            raise ValueError(f"keep_n must be >= 1, got {keep_n}")
         self._dir = os.path.abspath(directory)
         os.makedirs(self._dir, exist_ok=True)
         self.verify = verify
+        self.keep_n = keep_n
         self._on_event = on_event
         # Steps that already failed verification: skip re-hashing them (and
         # re-warning) on every later latest_step/restore call — a corrupt
@@ -117,8 +121,14 @@ class CheckpointManager:
         # gate on all_steps() and leave the one full verification to
         # restore_latest (as the trainer's resume path does).
         self._rejected: set[int] = set()
+        # Retention is OURS (_gc), not Orbax's: max_to_keep would reap an
+        # out-of-order re-save the moment it lands (replaying past a
+        # rollback on a resumed run re-saves steps BELOW the stale latest
+        # — Orbax deletes the fresh dir, _write_manifest then hashes an
+        # empty directory, and the run's most recent recovery point
+        # silently vanishes; reproduced on the dev_chaos resume drill).
         self._mgr = ocp.CheckpointManager(
-            self._dir, options=ocp.CheckpointManagerOptions(max_to_keep=3)
+            self._dir, options=ocp.CheckpointManagerOptions(max_to_keep=None)
         )
 
     # ---- paths -----------------------------------------------------------
@@ -158,8 +168,21 @@ class CheckpointManager:
             except FileNotFoundError:
                 pass
         self._rejected.discard(step)
-        self._mgr.save(step, args=ocp.args.StandardSave(state))
+        # force=True: Orbax's default save policy SILENTLY SKIPS any step
+        # <= its latest — exactly what a replay past a rollback on a
+        # resumed run produces (re-saving 30 below a stale 40). Combined
+        # with the stale-delete above, the skip turned a re-save into a
+        # pure deletion: the run's newest recovery point vanished and an
+        # empty manifest blessed the ghost (caught by _write_manifest's
+        # guard; reproduced on the dev_chaos resume drill). The save
+        # cadence is the trainer's decision, never Orbax's.
+        self._mgr.save(step, args=ocp.args.StandardSave(state), force=True)
         if not self.verify:
+            # Pure-async mode: the save overlap is the whole point — no
+            # wait, no manifest. GC still runs (it only ever touches steps
+            # OTHER than this in-flight one); a not-yet-finalized step is
+            # simply invisible to all_steps until the next save's pass.
+            self._gc(current=step)
             return
         # Verified checkpointing trades the async-save overlap for
         # integrity: the manifest must hash the FINAL bytes, so wait for
@@ -171,7 +194,38 @@ class CheckpointManager:
         self._mgr.wait_until_finished()
         if jax.process_index() == 0:
             self._write_manifest(step)
-            self._prune_aux("manifest_*.json", keyfield=1)
+            self._prune_aux("manifest_*.json", keyfield=1, keep_step=step)
+        self._gc(current=step)
+
+    def _gc(self, current: int) -> None:
+        """Retention (ISSUE 15 satellite): keep the newest ``keep_n``
+        steps, delete older superseded steps plus their manifests. Only
+        runs from ``save`` AFTER the new step landed (and, with
+        verification on, after its manifest hashed the final bytes) — so
+        every collection is superseded by a just-verified newer step,
+        never a blind delete. Replay-path deletion used to be the only
+        pruning; long runs accumulated steps unboundedly.
+
+        ``current`` — the step this pass just saved — is never deleted:
+        after a rollback on a resumed run, the replay re-saves steps
+        numerically BELOW stale steps from the abandoned timeline, and
+        "newest keep_n" alone would reap the run's actual recovery point
+        (keep_n=1 with a stale later step would leave ONLY the stale
+        one). Stale-but-newer steps linger until the replay passes and
+        re-saves them — bounded by the old timeline's length, and still
+        valid restore targets on this deterministic replay anyway."""
+        steps = self.all_steps()
+        if len(steps) <= self.keep_n:
+            return
+        for old in steps[:-self.keep_n]:
+            if old == current:
+                continue
+            self._mgr.delete(old)
+            self._rejected.discard(old)
+            try:
+                os.remove(self._manifest_path(old))
+            except FileNotFoundError:
+                pass
 
     def _write_manifest(self, step: int) -> None:
         root = self.step_dir(step)
@@ -186,6 +240,15 @@ class CheckpointManager:
                     "size": os.path.getsize(p),
                     "sha256": _sha256_file(p),
                 }
+        if not files:
+            # A manifest hashing nothing would "verify" a checkpoint that
+            # no longer exists (seen when Orbax retention reaped the step
+            # dir between save and fingerprint). Fail loud: an empty
+            # checkpoint is never a valid restore target.
+            raise RuntimeError(
+                f"checkpoint step {step}: no files under {root} at "
+                "manifest time — step dir vanished before fingerprinting"
+            )
         _atomic_write_json(
             self._manifest_path(step), {"step": step, "files": files}
         )
@@ -351,18 +414,32 @@ class CheckpointManager:
             os.path.join(self._dir, f"stream_{step}_p{process_index}.json"),
             position,
         )
-        self._prune_aux(f"stream_*_p{process_index}.json", keyfield=1)
+        self._prune_aux(
+            f"stream_*_p{process_index}.json", keyfield=1, keep_step=step
+        )
 
-    def _prune_aux(self, pattern: str, keyfield: int) -> None:
-        """Mirror max_to_keep=3 for our auxiliary files (Orbax's GC won't
-        touch them)."""
+    def _prune_aux(
+        self, pattern: str, keyfield: int, keep_step: int | None = None
+    ) -> None:
+        """Mirror ``keep_n`` retention for our auxiliary files (Orbax's
+        GC won't touch them). ``keep_step`` — the step this save pass
+        just wrote — is exempt, same as ``_gc``'s current-step guard:
+        a replay re-save numerically below >= keep_n stale steps would
+        otherwise lose its just-written manifest/sidecar, silently
+        stripping integrity verification (verify_step trusts a
+        manifest-less step) from the run's actual recovery point."""
         paths = sorted(
             glob.glob(os.path.join(self._dir, pattern)),
             key=lambda p: int(
                 os.path.basename(p).split("_")[keyfield].split(".")[0]
             ),
         )
-        for p in paths[:-3]:
+        for p in paths[:-self.keep_n]:
+            step = int(
+                os.path.basename(p).split("_")[keyfield].split(".")[0]
+            )
+            if keep_step is not None and step == keep_step:
+                continue
             os.remove(p)
 
     def load_stream(self, step: int, process_index: int = 0) -> dict | None:

@@ -16,8 +16,8 @@ timeout -k 10 120 env JAX_PLATFORMS=cpu python -m pytest --collect-only -q -p no
   tests/test_analysis.py tests/test_numerics.py tests/test_bf16.py \
   tests/test_serve.py tests/test_trace.py tests/test_devprof.py \
   tests/test_adapters.py tests/test_overlap_collectives.py \
-  tests/test_router.py > /dev/null || {
-    echo "tier-1 pre-gate: MoE/HLO/decode/analysis/serve/trace/devprof/adapters/overlap/router test collection failed" >&2; exit 1; }
+  tests/test_router.py tests/test_elastic.py > /dev/null || {
+    echo "tier-1 pre-gate: MoE/HLO/decode/analysis/serve/trace/devprof/adapters/overlap/router/elastic test collection failed" >&2; exit 1; }
 # Pre-gate 2 (ISSUE 5 + 6): the graph audit — lower/compile the
 # dp/tp/fsdp/ep train steps (8-virtual-device CPU mesh), the greedy decode
 # scan, AND the serving (continuous-batching) decode step; run the rule
@@ -97,4 +97,14 @@ timeout -k 10 300 env JAX_PLATFORMS=cpu python scripts/adapter_smoke.py || {
 # tenant/prefix affinity actually routing. ~1-2 min.
 timeout -k 10 300 env JAX_PLATFORMS=cpu python scripts/fleet_smoke.py || {
     echo "tier-1 pre-gate: serving-fleet smoke failed" >&2; exit 1; }
+# Pre-gate 8 (ISSUE 15): elastic-training smoke — kill a virtual host at
+# step 6 of an 8-device DP x FSDP run; heartbeat detection + in-memory
+# snapshot restore (<= 1 step lost, ring-mirror sourced) + 8 -> 4 shrink
+# must finish the token budget. Asserts the bit-exact snapshot-replay
+# gate (a shrunk restart from the resize's cold spill replays the
+# post-resize losses identically), the loss-parity gate vs an
+# uninterrupted run, typed host_lost/elastic_resize events, and exactly
+# ONE recompile at the first replayed step. ~1-2 min.
+timeout -k 10 300 env JAX_PLATFORMS=cpu python scripts/elastic_smoke.py || {
+    echo "tier-1 pre-gate: elastic-training smoke failed" >&2; exit 1; }
 set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc

@@ -57,6 +57,8 @@ _QUICK_EXCLUDE_FILES = {
     "test_checkpoint.py",
     # Drives full chaos finetune + mixed-tenant chaos serving runs.
     "test_adapters.py",
+    # Drives full elastic kill/shrink chaos training runs (ISSUE 15).
+    "test_elastic.py",
 }
 
 

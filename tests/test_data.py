@@ -3,7 +3,11 @@
 import numpy as np
 
 from dtc_tpu.data.packing import pack_token_stream
-from dtc_tpu.data.synthetic import synthetic_batch_iterator
+from dtc_tpu.data.synthetic import (
+    synthetic_batch_iterator,
+    synthetic_row,
+    synthetic_row_batches,
+)
 from dtc_tpu.data.tokenizer import GPT2_PADDED_VOCAB, get_tokenizer
 
 
@@ -43,6 +47,36 @@ def test_synthetic_has_learnable_structure():
     batch = next(synthetic_batch_iterator(8, 256, 97, seed=0))
     match = (batch[:, 8:] == batch[:, :-8]).mean()
     assert match > 0.3
+
+
+def test_row_stream_reseek_is_batch_shape_independent():
+    """The elastic-shrink data contract (ISSUE 15 satellite): the row
+    stream is a flat sequence of independently-seeded rows, so after
+    consuming T tokens at ANY batch size, resuming at start_row =
+    T / seq_len — at a DIFFERENT batch size — continues the exact same
+    flat row sequence an uninterrupted iterator would produce."""
+    seq, vocab, seed = 9, 53, 3
+
+    def rows(batch, n_batches, start_row=0):
+        it = synthetic_row_batches(batch, seq, vocab, seed, start_row)
+        return np.concatenate([next(it) for _ in range(n_batches)])
+
+    # Same flat row sequence whatever the batch shape.
+    np.testing.assert_array_equal(rows(8, 3), rows(4, 6))
+    np.testing.assert_array_equal(rows(8, 3), rows(3, 8))
+    # Mid-run resize: 2 batches at global batch 8 (16 rows = 16*seq
+    # tokens consumed), then resume at batch 4 from the token count —
+    # identical to the uninterrupted batch-4 stream from the same point.
+    consumed_rows = 2 * 8  # tokens_consumed // seq
+    resumed = rows(4, 4, start_row=consumed_rows)
+    uninterrupted = rows(4, 8)[16:]
+    np.testing.assert_array_equal(resumed, uninterrupted)
+    # Row identity is positional, not batch-relative.
+    np.testing.assert_array_equal(
+        rows(8, 1)[5], synthetic_row(seq, vocab, seed, 5)
+    )
+    # Distinct rows actually differ (not a constant stream).
+    assert not np.array_equal(rows(8, 1)[0], rows(8, 1)[1])
 
 
 def test_tokenizer_offline_fallback_is_opt_in():

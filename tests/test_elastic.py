@@ -1,0 +1,635 @@
+"""Elastic training (ISSUE 15): async in-memory snapshots, peer-redundant
+shard stores, heartbeat failure detection, and shrink-and-continue.
+
+Unit layer: virtual hosts, the heartbeat monitor (straggler vs loss,
+collective-stall escalation), shrink-mesh planning, and the snapshot
+store's redundancy plan / ring-mirror restore / integrity hashing.
+
+Acceptance layer (the PR 2 chaos pattern lifted a level): kill a virtual
+host at step k on an 8-device DP x FSDP CPU run — the run must detect the
+loss by heartbeats alone, restore the last COMPLETE in-memory snapshot
+(<= 1 step of lost work) onto a survivors-only 4-device mesh, re-seek the
+row stream by tokens consumed, and finish the token budget with loss
+parity against an uninterrupted run. The post-resize trajectory is then
+proven BIT-IDENTICAL to a snapshot-replay reference: a fresh shrunk
+restart (elastic.dead_hosts) resuming from the resize's cold spill.
+"""
+
+import dataclasses
+import glob
+import json
+import os
+
+import numpy as np
+import pytest
+
+from dtc_tpu.config.schema import (
+    ChaosConfig,
+    ElasticConfig,
+    ResilienceConfig,
+)
+from dtc_tpu.train.trainer import train
+
+pytestmark = pytest.mark.filterwarnings("ignore::DeprecationWarning")
+
+
+# ---------------------------------------------------------------------------
+# virtual hosts + heartbeat monitor
+
+
+def test_virtual_hosts_split_kill_and_ring():
+    from dtc_tpu.resilience import VirtualHosts
+
+    hosts = VirtualHosts(2)
+    assert hosts.per_host == 4
+    assert {hosts.host_of(d) for d in hosts.devices[:4]} == {0}
+    assert {hosts.host_of(d) for d in hosts.devices[4:]} == {1}
+    assert hosts.ring_next(1) == 0
+    hosts.kill(0)
+    assert hosts.alive == {1}
+    assert [d.id for d in hosts.survivor_devices()] == [
+        d.id for d in hosts.devices_of(1)
+    ]
+    with pytest.raises(ValueError, match="do not split"):
+        VirtualHosts(3)
+    with pytest.raises(ValueError, match=">= 2"):
+        VirtualHosts(1)
+
+
+def test_host_monitor_loss_straggler_and_escalation():
+    from dtc_tpu.resilience import HostMonitor, VirtualHosts
+
+    hosts = VirtualHosts(2)
+    mon = HostMonitor(hosts, miss_limit=2)
+    mon.tick(1)
+    assert mon.poll(1) == []
+    # Straggle below miss_limit: flagged host_slow exactly once, never lost.
+    mon.mark_slow(1, 2)
+    mon.tick(2)
+    ev = mon.poll(2)
+    assert [e["kind"] for e in ev] == ["host_slow"] and ev[0]["host"] == 1
+    mon.tick(3)
+    assert mon.poll(3) == [], "healed straggler re-flags nothing"
+    assert mon.lost == set()
+    # Real loss: detection by BEAT HISTORY, miss_limit beats later.
+    hosts.kill(0)
+    mon.tick(4)
+    assert [e["kind"] for e in mon.poll(4)] == ["host_slow"]
+    mon.tick(5)
+    ev = mon.poll(5)
+    assert [e["kind"] for e in ev] == ["host_lost"] and ev[0]["host"] == 0
+    assert ev[0]["escalated"] is False
+    assert mon.poll(6) == [], "a lost host is reported exactly once"
+
+
+def test_host_monitor_collective_stall_escalates():
+    from dtc_tpu.resilience import HostMonitor, VirtualHosts
+
+    hosts = VirtualHosts(2)
+    mon = HostMonitor(hosts, miss_limit=3)
+    mon.tick(1)
+    hosts.kill(1)
+    mon.tick(2)
+    # One missed beat + a hung-step (wedged collective) flag -> lost NOW,
+    # not miss_limit steps later.
+    ev = mon.poll(2, stalled=True)
+    assert [e["kind"] for e in ev] == ["host_lost"]
+    assert ev[0]["escalated"] is True and ev[0]["missed"] == 1
+
+
+def test_monitor_detects_kill_before_first_tick():
+    """The trainer applies chaos kills BEFORE the heartbeat tick in the
+    same loop iteration, so a ``kill_host_at_step`` on the very first
+    step removes the victim from ``alive`` before any beat is recorded.
+    The roster is frozen at construction (after ``dead_hosts``), not on
+    the first tick — otherwise the victim never enters the beat table
+    and the loss is silently never detected."""
+    from dtc_tpu.resilience import HostMonitor, VirtualHosts
+
+    hosts = VirtualHosts(2)
+    mon = HostMonitor(hosts, miss_limit=2)
+    hosts.kill(0)  # chaos fires before the first tick
+    mon.tick(1)
+    mon.tick(2)
+    ev = mon.poll(2)
+    assert [e["kind"] for e in ev] == ["host_lost"] and ev[0]["host"] == 0
+
+
+def test_monitor_ignores_hosts_dead_at_start():
+    from dtc_tpu.resilience import HostMonitor, VirtualHosts
+
+    hosts = VirtualHosts(2)
+    hosts.kill(0)  # shrunk RESTART: host 0 was never part of this run
+    mon = HostMonitor(hosts, miss_limit=1)
+    mon.tick(1)
+    assert mon.poll(1) == []
+    mon.tick(2)
+    assert mon.poll(2) == [], "a host dead at start must not be 'detected'"
+
+
+# ---------------------------------------------------------------------------
+# shrink planning
+
+
+def test_shrink_mesh_absorbs_survivors_into_data_axis():
+    from dtc_tpu.parallel.mesh import build_mesh
+    from dtc_tpu.resilience import VirtualHosts, shrink_mesh
+
+    hosts = VirtualHosts(2)
+    hosts.kill(1)
+    small = shrink_mesh(build_mesh((1, 4, 2)), hosts)
+    assert dict(small.shape) == {"pipe": 1, "data": 2, "model": 2}, (
+        "model (TP) axis preserved; data absorbs the survivors"
+    )
+    assert {d.id for d in small.devices.flat} == {
+        d.id for d in hosts.survivor_devices()
+    }
+
+
+def test_shrink_mesh_rejects_broken_tp_and_pipeline():
+    from dtc_tpu.parallel.mesh import build_mesh
+    from dtc_tpu.resilience import VirtualHosts, shrink_mesh
+    from dtc_tpu.resilience.errors import ElasticAbort
+
+    hosts = VirtualHosts(2)
+    hosts.kill(0)
+    with pytest.raises(ElasticAbort, match="model=8"):
+        shrink_mesh(build_mesh((1, 1, 8)), hosts)
+    with pytest.raises(ElasticAbort, match="pipeline"):
+        shrink_mesh(build_mesh((2, 4, 1)), hosts)
+    hosts.kill(1)
+    with pytest.raises(ElasticAbort, match="no surviving"):
+        shrink_mesh(build_mesh((1, 8, 1)), hosts)
+
+
+# ---------------------------------------------------------------------------
+# snapshot store: redundancy plan, ring mirror, integrity
+
+
+def _fsdp_state(mesh):
+    import jax
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    return {
+        "w": jax.device_put(
+            np.arange(32, dtype=np.float32).reshape(8, 4),
+            NamedSharding(mesh, P("data", None)),
+        ),
+        "b": jax.device_put(
+            np.full((4,), 7.0, np.float32), NamedSharding(mesh, P())
+        ),
+    }
+
+
+def _snap_fixture():
+    from dtc_tpu.parallel.mesh import build_mesh
+    from dtc_tpu.resilience import SnapshotStore, VirtualHosts
+
+    mesh = build_mesh((1, 8, 1))
+    hosts = VirtualHosts(2)
+    events = []
+    store = SnapshotStore(
+        hosts, keep=4, on_event=lambda et, **f: events.append((et, f))
+    )
+    state = _fsdp_state(mesh)
+    assert store.begin(1, state)
+    store.drain()
+    return mesh, hosts, store, state, events
+
+
+def test_snapshot_redundancy_plan_and_recovery_set():
+    from dtc_tpu.resilience import RedundancyPlan
+
+    mesh, hosts, store, state, events = _snap_fixture()
+    try:
+        snap = store.latest()
+        assert snap is not None and snap.step == 1 and snap.complete
+        assert events and events[0][0] == "snapshot"
+        assert events[0][1]["sha256"] == snap.sha256[:16]
+        plan = RedundancyPlan.from_snapshot(snap)
+        assert plan.kind == {"w": "sharded", "b": "replicated"}
+        # All alive: every shard sourced from a primary.
+        src = plan.recovery_set(snap, {0, 1})
+        assert all(t == "primary" for picks in src.values() for _, t, _ in picks)
+        # Host 0 gone: its FSDP shards come from the ring mirror at host 1;
+        # the replicated leaf from host 1's own primary.
+        src = plan.recovery_set(snap, {1})
+        tiers_w = {t for _, t, _ in src["w"]}
+        assert "mirror" in tiers_w
+        assert src["b"][0][1] == "primary"
+    finally:
+        store.close()
+
+
+def test_snapshot_restore_reshards_onto_smaller_mesh_via_mirror():
+    from dtc_tpu.resilience import shrink_mesh
+
+    mesh, hosts, store, state, _ = _snap_fixture()
+    try:
+        hosts.kill(0)
+        small = shrink_mesh(mesh, hosts)
+        restored, used_mirror = store.restore(
+            store.latest(), hosts.alive, small
+        )
+        assert used_mirror, "host 0's shards must come from the ring mirror"
+        np.testing.assert_array_equal(
+            np.asarray(restored["w"]), np.asarray(state["w"])
+        )
+        np.testing.assert_array_equal(
+            np.asarray(restored["b"]), np.asarray(state["b"])
+        )
+        assert restored["w"].sharding.mesh.shape["data"] == 4
+    finally:
+        store.close()
+
+
+def test_snapshot_post_kill_commits_are_incomplete_and_skipped():
+    mesh, hosts, store, state, events = _snap_fixture()
+    try:
+        hosts.kill(0)
+        assert store.begin(2, state)
+        store.drain()
+        assert store.latest().step == 1, (
+            "a snapshot taken after the host died cannot be complete and "
+            "must never become the recovery target"
+        )
+        assert events[-1][1]["complete"] is False
+    finally:
+        store.close()
+
+
+def test_snapshot_integrity_hash_guards_every_read():
+    from dtc_tpu.resilience import SnapshotIncompleteError
+
+    mesh, hosts, store, state, _ = _snap_fixture()
+    try:
+        snap = store.latest()
+        # Tamper host 0's primary copy of one FSDP shard: restore must
+        # hash-reject it and heal from the mirror, values intact.
+        path_store = snap.primary[0]["w"]
+        key = next(iter(path_store))
+        path_store[key] = path_store[key] + 1.0
+        restored, used_mirror = store.restore(snap, {0, 1}, mesh)
+        assert used_mirror
+        np.testing.assert_array_equal(
+            np.asarray(restored["w"]), np.asarray(state["w"])
+        )
+        # Tamper the mirror too: no intact copy anywhere -> typed error,
+        # never silently-wrong state.
+        for h in snap.mirror:
+            if "w" in snap.mirror[h] and key in snap.mirror[h]["w"]:
+                snap.mirror[h]["w"][key] = snap.mirror[h]["w"][key] + 1.0
+        with pytest.raises(SnapshotIncompleteError, match="integrity"):
+            store.restore(snap, {0, 1}, mesh)
+    finally:
+        store.close()
+
+
+def test_snapshot_drop_primary_forces_mirror():
+    mesh, hosts, store, state, _ = _snap_fixture()
+    try:
+        assert store.drop_primary(0)
+        restored, used_mirror = store.restore(store.latest(), {0, 1}, mesh)
+        assert used_mirror
+        np.testing.assert_array_equal(
+            np.asarray(restored["w"]), np.asarray(state["w"])
+        )
+    finally:
+        store.close()
+
+
+def test_snapshot_double_buffer_skips_instead_of_queueing():
+    import threading
+
+    from dtc_tpu.parallel.mesh import build_mesh
+    from dtc_tpu.resilience import SnapshotStore, VirtualHosts
+
+    mesh = build_mesh((1, 8, 1))
+    store = SnapshotStore(VirtualHosts(2), keep=2)
+    gate = threading.Event()
+    orig = store._commit
+
+    def slow_commit(*a, **k):
+        gate.wait(timeout=10.0)
+        orig(*a, **k)
+
+    store._commit = slow_commit
+    try:
+        state = _fsdp_state(mesh)
+        assert store.begin(1, state), "first slot: committing"
+        assert store.begin(2, state), "second slot: queued behind it"
+        assert not store.begin(3, state), "third tick is skipped, not queued"
+        assert store.skipped == 1
+        gate.set()
+        store.drain()
+        assert store.latest().step == 2
+        assert store.begin(4, state), "slots free again after the commits"
+        store.drain()
+        assert store.latest().step == 4
+    finally:
+        gate.set()
+        store.close()
+
+
+# ---------------------------------------------------------------------------
+# config validation
+
+
+def test_elastic_config_validates():
+    with pytest.raises(ValueError, match="keep"):
+        ElasticConfig(keep=1)
+    with pytest.raises(ValueError, match="n_virtual_hosts"):
+        ElasticConfig(n_virtual_hosts=1)
+    with pytest.raises(ValueError, match="every host dead"):
+        ElasticConfig(n_virtual_hosts=2, dead_hosts=(0, 1))
+    with pytest.raises(ValueError, match="outside"):
+        ElasticConfig(n_virtual_hosts=2, dead_hosts=(2,))
+    # Chaos elastic faults without the elastic layer would silently never
+    # fire — rejected at config time.
+    with pytest.raises(ValueError, match="require resilience.elastic"):
+        ResilienceConfig(
+            chaos=ChaosConfig(enabled=True, kill_host_at_step=3)
+        )
+    with pytest.raises(ValueError, match="elastic_target_host"):
+        ResilienceConfig(
+            elastic=ElasticConfig(enabled=True, n_virtual_hosts=2),
+            chaos=ChaosConfig(
+                enabled=True, kill_host_at_step=3, elastic_target_host=5
+            ),
+        )
+    ResilienceConfig(
+        elastic=ElasticConfig(enabled=True),
+        chaos=ChaosConfig(enabled=True, kill_host_at_step=3),
+    )
+
+
+# ---------------------------------------------------------------------------
+# acceptance: kill -> detect -> restore -> shrink -> continue
+
+
+def _read_events(output_dir: str) -> list[dict]:
+    events = []
+    for p in glob.glob(os.path.join(output_dir, "obs", "*.jsonl")):
+        with open(p) as f:
+            events += [json.loads(line) for line in f if line.strip()]
+    return events
+
+
+def _elastic_cfg(train_cfg_factory, tmp_path, name, *, chaos=None,
+                 elastic=None, resume=False, **kw):
+    el = elastic or ElasticConfig(
+        enabled=True, snapshot_every=1, keep=4, n_virtual_hosts=2
+    )
+    defaults = dict(
+        steps=8, warmup_steps=1, log_every=2, checkpoint_every=100,
+        output_dir=str(tmp_path / name),
+        checkpoint_dir=str(tmp_path / f"{name}_ckpt"),
+    )
+    defaults.update(kw)
+    cfg = train_cfg_factory("fsdp", **defaults)
+    return dataclasses.replace(
+        cfg, resume=resume,
+        resilience=ResilienceConfig(elastic=el, chaos=chaos or ChaosConfig()),
+    )
+
+
+@pytest.fixture(scope="module")
+def clean_elastic_run(tmp_path_factory):
+    """Uninterrupted 8-device run with the elastic layer on (snapshots
+    every step, no faults) — the parity reference every chaos leg below
+    compares against."""
+    from tests.conftest import make_train_cfg
+
+    tmp = tmp_path_factory.mktemp("elastic_clean")
+    cfg = _elastic_cfg(make_train_cfg, tmp, "clean")
+    tiny = {
+        "vocab_size": 97, "d_model": 64, "n_layers": 4, "n_heads": 4,
+        "d_ff": 128, "max_seq_len": 32, "dropout": 0.0,
+        "param_dtype": "float32", "compute_dtype": "float32",
+        "attention": "dense",
+    }
+    from dtc_tpu.config.schema import ModelConfig, OptimConfig
+
+    model_cfg = ModelConfig(**tiny)
+    opt = OptimConfig(lr=1e-3, weight_decay=0.1, grad_clip=1.0)
+    result = train(cfg, model_cfg, opt)
+    assert len(result.losses) == 8
+    return result, model_cfg, opt
+
+
+def test_kill_host_shrinks_and_continues_with_parity(
+    clean_elastic_run, train_cfg_factory, tmp_path
+):
+    """The flagship gate: kill virtual host 0 at step 6 of an 8-device
+    DP x FSDP run. Detection is heartbeat-only, recovery restores the
+    step-5 in-memory snapshot (<= 1 step lost) through the ring mirror,
+    the mesh shrinks 8 -> 4 devices with the global batch preserved, and
+    the run finishes the token budget with loss parity vs uninterrupted.
+    Then the snapshot-replay reference: a shrunk RESTART resuming from
+    the resize's cold spill replays the post-resize trajectory
+    BIT-IDENTICALLY."""
+    clean, model_cfg, opt = clean_elastic_run
+    cfg = _elastic_cfg(
+        train_cfg_factory, tmp_path, "kill",
+        chaos=ChaosConfig(
+            enabled=True, kill_host_at_step=6, elastic_target_host=0
+        ),
+    )
+    chaotic = train(cfg, model_cfg, opt)
+    assert len(chaotic.losses) == 8
+    assert dict(chaotic.mesh.shape) == {"pipe": 1, "data": 4, "model": 1}
+    # Pre-kill prefix: same mesh, same data, same RNG — bit-identical.
+    np.testing.assert_array_equal(chaotic.losses[:5], clean.losses[:5])
+    # Post-shrink: same global batch and row stream, different reduction
+    # geometry — parity within the float-reassociation gate.
+    np.testing.assert_allclose(
+        chaotic.losses[5:], clean.losses[5:], rtol=1e-3, atol=1e-5
+    )
+
+    events = _read_events(cfg.output_dir)
+    lost = [e for e in events if e["etype"] == "host_lost"]
+    assert len(lost) == 1 and lost[0]["host"] == 0, (
+        "no silent restarts: the loss must be a typed event"
+    )
+    rz = [e for e in events if e["etype"] == "elastic_resize"]
+    assert len(rz) == 1
+    assert rz[0]["to_step"] == 5, "<= 1 step of lost work (kill at 6)"
+    assert rz[0]["tier"] == "memory" and rz[0]["used_mirror"] is True
+    assert rz[0]["devices"] == 4
+    assert any(e["etype"] == "elastic_spill" for e in events)
+    snaps = [e for e in events if e["etype"] == "snapshot"]
+    assert snaps and all("sha256" in e for e in snaps)
+    assert any(e.get("complete") is False for e in snaps), (
+        "the post-kill partial snapshot is committed-but-excluded"
+    )
+    # The one expected compile on mesh change is ASSERTED, not excused:
+    # exactly one recompile event, at the first replayed step; the
+    # steady-state steps on either side show none.
+    rc = [e for e in events if e["etype"] == "recompile"]
+    assert len(rc) == 1 and rc[0]["step"] == 6, rc
+
+    # Snapshot-replay reference (bit-identity gate): shrunk restart from
+    # the spilled cold checkpoint, same survivor mesh, same stream seek.
+    cfg_b = _elastic_cfg(
+        train_cfg_factory, tmp_path, "replay",
+        elastic=ElasticConfig(
+            enabled=True, snapshot_every=1, keep=4, n_virtual_hosts=2,
+            dead_hosts=(0,),
+        ),
+        resume=True,
+    )
+    cfg_b = dataclasses.replace(
+        cfg_b, checkpoint_dir=str(tmp_path / "kill_ckpt")
+    )
+    replay = train(cfg_b, model_cfg, opt)
+    assert len(replay.losses) == 3, "resumed at the spilled step 5"
+    np.testing.assert_array_equal(chaotic.losses[5:], replay.losses)
+    replay_events = _read_events(cfg_b.output_dir)
+    assert not any(e["etype"] == "host_lost" for e in replay_events), (
+        "a host dead at startup is not re-detected"
+    )
+
+
+def test_straggler_is_flagged_not_killed(
+    clean_elastic_run, train_cfg_factory, tmp_path
+):
+    """Detection specificity: a host whose beats arrive late (below
+    miss_limit) is a straggler — typed host_slow, NO resize, losses
+    bit-identical to the clean run."""
+    clean, model_cfg, opt = clean_elastic_run
+    cfg = _elastic_cfg(
+        train_cfg_factory, tmp_path, "slow",
+        chaos=ChaosConfig(
+            enabled=True, slow_host_at_step=4, slow_host_iters=1,
+            elastic_target_host=1,
+        ),
+    )
+    result = train(cfg, model_cfg, opt)
+    np.testing.assert_array_equal(result.losses, clean.losses)
+    events = _read_events(cfg.output_dir)
+    slow = [e for e in events if e["etype"] == "host_slow"]
+    assert len(slow) == 1 and slow[0]["host"] == 1
+    assert not any(e["etype"] == "host_lost" for e in events)
+    assert not any(e["etype"] == "elastic_resize" for e in events)
+    assert dict(result.mesh.shape)["data"] == 8
+
+
+def test_lost_snapshot_and_torn_spill_fall_back_verified(
+    clean_elastic_run, train_cfg_factory, tmp_path
+):
+    """Two storage faults on one kill run: the victim's primary hot-tier
+    copy vanishes (recovery must take the ring mirror, hash-verified) and
+    the cold-tier spill is torn mid-write (a later restore must REJECT
+    it instead of resuming from torn bytes)."""
+    clean, model_cfg, opt = clean_elastic_run
+    cfg = _elastic_cfg(
+        train_cfg_factory, tmp_path, "torn",
+        chaos=ChaosConfig(
+            enabled=True, kill_host_at_step=5, lose_snapshot_at_step=5,
+            torn_cold_spill_at_step=4, elastic_target_host=0,
+        ),
+    )
+    result = train(cfg, model_cfg, opt)
+    assert len(result.losses) == 8
+    np.testing.assert_allclose(
+        result.losses[4:], clean.losses[4:], rtol=1e-3, atol=1e-5
+    )
+    events = _read_events(cfg.output_dir)
+    kinds = {e["kind"] for e in events if e["etype"] == "chaos"}
+    assert kinds == {"kill_host", "lose_snapshot", "torn_cold_spill"}
+    rz = [e for e in events if e["etype"] == "elastic_resize"]
+    assert len(rz) == 1 and rz[0]["tier"] == "memory" and rz[0]["used_mirror"]
+    # The torn spill (step 4) must fail verification on a fresh restore.
+    from dtc_tpu.utils.checkpoint import CheckpointManager
+
+    mgr = CheckpointManager(cfg.checkpoint_dir, verify=True)
+    try:
+        assert mgr.latest_step() != 4, "torn cold spill must be rejected"
+    finally:
+        mgr.close()
+
+
+def test_nan_rollback_restores_hot_tier_below_healthy_boundary(
+    clean_elastic_run, train_cfg_factory, tmp_path
+):
+    """Guard rollback with elastic on restores from the in-memory hot
+    tier — and STRICTLY below the last healthy log boundary. A step's
+    loss validates the params going INTO it, so the previous window's
+    healthy losses (through boundary step 4 here) vouch for snapshots
+    only through step 3: the snapshot AT 4 holds step 4's
+    never-validated update. NaN at 5, windows of 2 -> detection at 6,
+    boundary 4, restore target 3. No cold checkpoint exists yet
+    (checkpoint_every=100), so this also pins that the hot tier alone
+    can serve the guard ladder."""
+    clean, model_cfg, opt = clean_elastic_run
+    cfg = _elastic_cfg(
+        train_cfg_factory, tmp_path, "nanroll",
+        chaos=ChaosConfig(enabled=True, nan_at_step=5),
+    )
+    result = train(cfg, model_cfg, opt)
+    assert len(result.losses) == 8
+    np.testing.assert_allclose(result.losses, clean.losses, rtol=1e-6)
+    events = _read_events(cfg.output_dir)
+    rb = next(e for e in events if e["etype"] == "recovery"
+              and e["action"] == "rollback")
+    assert rb["tier"] == "memory"
+    assert rb["to_step"] == 3, (
+        "hot-tier target must be boundary-1: the boundary step's own "
+        "update was never validated by an observed loss"
+    )
+    assert not any(e["etype"] in ("host_lost", "elastic_resize")
+                   for e in events), "a NaN is not a host loss"
+
+
+def test_elastic_events_reach_reducer_and_perfetto(
+    clean_elastic_run, train_cfg_factory, tmp_path
+):
+    """Obs satellite: the recovery chain shows up in the cross-host shard
+    reducer ('elastic' section) and as Perfetto instants."""
+    clean, model_cfg, opt = clean_elastic_run
+    cfg = _elastic_cfg(
+        train_cfg_factory, tmp_path, "obs",
+        chaos=ChaosConfig(
+            enabled=True, kill_host_at_step=6, elastic_target_host=1
+        ),
+    )
+    train(cfg, model_cfg, opt)
+    from dtc_tpu.obs.aggregate import reduce_shards
+    from dtc_tpu.obs.trace import to_chrome_trace
+
+    reduced = reduce_shards(os.path.join(cfg.output_dir, "obs"))
+    assert reduced is not None and "elastic" in reduced
+    el = reduced["elastic"]
+    assert el["snapshots"] >= 5
+    assert [h["host"] for h in el["hosts_lost"]] == [1]
+    assert len(el["resizes"]) == 1 and el["resizes"][0]["tier"] == "memory"
+    assert el["spills"] == 1
+
+    trace = to_chrome_trace(_read_events(cfg.output_dir))
+    names = {e["name"] for e in trace["traceEvents"]}
+    assert {"snapshot", "host_lost", "elastic_resize"} <= names
+
+
+def test_elastic_validation_gates():
+    """Unsupported combinations fail loudly at startup, not mid-recovery."""
+    from tests.conftest import make_train_cfg
+    from dtc_tpu.config.schema import ModelConfig, OptimConfig
+
+    model_cfg = ModelConfig(
+        vocab_size=97, d_model=64, n_layers=4, n_heads=4, d_ff=128,
+        max_seq_len=32, dropout=0.0, param_dtype="float32",
+        compute_dtype="float32", attention="dense",
+    )
+    opt = OptimConfig(lr=1e-3, weight_decay=0.1, grad_clip=1.0)
+    el = ResilienceConfig(elastic=ElasticConfig(enabled=True))
+    cfg = dataclasses.replace(
+        make_train_cfg("fsdp", steps=1, dataset="fineweb"), resilience=el
+    )
+    with pytest.raises(ValueError, match="dataset: synthetic"):
+        train(cfg, model_cfg, opt)
+    cfg = dataclasses.replace(
+        make_train_cfg("pp", steps=1), resilience=el
+    )
+    with pytest.raises(ValueError, match="pipeline"):
+        train(cfg, model_cfg, opt)

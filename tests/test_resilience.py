@@ -143,6 +143,42 @@ def test_guard_tolerates_when_updates_skipped_device_side():
     assert g.check_window(5, [float("nan")]).action == "tolerate"
 
 
+def test_guard_forgiveness_resets_budget_after_clean_streak():
+    """Regression (ISSUE 15 satellite): two WELL-SEPARATED transients on
+    a long run must both be rollback-able when clean_steps_to_forgive is
+    set — max_rollbacks bounds rollbacks per incident, not per run
+    lifetime (a week-long run used to die on its Nth transient)."""
+    from dtc_tpu.resilience import AnomalyGuard
+
+    g = AnomalyGuard(
+        GuardConfig(max_rollbacks=1, clean_steps_to_forgive=3),
+        can_rollback=True,
+    )
+    # Incident 1: NaN -> the one budgeted rollback.
+    assert g.check_window(1, [float("nan")]).action == "rollback"
+    g.note_rollback()
+    # Three consecutive healthy windows forgive the incident...
+    for s in (2, 3, 4):
+        assert g.check_window(s, [1.0, 0.9]).action == "ok"
+    # ...so incident 2 (well-separated NaN) rolls back again, no abort.
+    assert g.check_window(5, [float("nan")]).action == "rollback"
+    g.note_rollback()
+    # An anomaly RESETS the clean streak: two healthy windows are not
+    # enough, the next anomaly inside the un-forgiven window aborts.
+    assert g.check_window(6, [1.0]).action == "ok"
+    assert g.check_window(7, [1.0]).action == "ok"
+    assert g.check_window(8, [float("inf")]).action == "abort"
+
+    # Legacy lifetime budget (forgive=0): the second transient aborts
+    # even after an arbitrarily long clean streak.
+    g0 = AnomalyGuard(GuardConfig(max_rollbacks=1), can_rollback=True)
+    assert g0.check_window(1, [float("nan")]).action == "rollback"
+    g0.note_rollback()
+    for s in range(2, 12):
+        assert g0.check_window(s, [1.0]).action == "ok"
+    assert g0.check_window(12, [float("nan")]).action == "abort"
+
+
 def test_guard_healthy_loss_rejects_finite_spike():
     from dtc_tpu.resilience import AnomalyGuard
 
@@ -314,6 +350,74 @@ def test_save_overwrites_stale_step_after_rollback(tmp_path):
     restored, _ = mgr.restore_latest(_mini_state(0))
     np.testing.assert_array_equal(np.asarray(restored["params"]["w"]), 7.0)
     mgr.close()
+
+
+def test_checkpoint_keep_n_gc_prunes_verified_older_steps(tmp_path):
+    """Retention (ISSUE 15 satellite): keep_n bounds the step count —
+    older steps (and their manifests) are garbage-collected after each
+    verified save, so long runs no longer accumulate unboundedly. GC only
+    ever runs AFTER the newer step verified, so the newest keep_n steps
+    always include an intact restore target."""
+    from dtc_tpu.utils.checkpoint import CheckpointManager
+
+    mgr = CheckpointManager(str(tmp_path), keep_n=2)
+    for s in (2, 4, 6, 8):
+        mgr.save(s, _mini_state(s))
+    assert mgr.all_steps() == [6, 8]
+    manifests = sorted(glob.glob(str(tmp_path / "manifest_*.json")))
+    assert [os.path.basename(m) for m in manifests] == [
+        "manifest_6.json", "manifest_8.json"
+    ], "manifest sidecars pruned with their steps"
+    assert not os.path.isdir(mgr.step_dir(2))
+    # Fallback still works inside the retained window.
+    _corrupt_largest_file(mgr.step_dir(8))
+    restored, step = mgr.restore_latest(_mini_state(0))
+    assert step == 6
+    np.testing.assert_array_equal(np.asarray(restored["params"]["w"]), 6.0)
+    mgr.close()
+    with pytest.raises(ValueError, match="keep_n"):
+        CheckpointManager(str(tmp_path / "bad"), keep_n=0)
+
+
+def test_checkpoint_replay_resave_below_stale_latest_survives_gc(tmp_path):
+    """A resumed run that fell back past corrupt steps re-saves steps
+    numerically BELOW the stale latest during replay. Orbax's own
+    max_to_keep retention used to reap that fresh out-of-order save the
+    moment it landed (leaving an empty manifest that blessed a vanished
+    step); retention is ours now, and the just-saved step is never the
+    GC victim — even at keep_n=1 with a stale later step on disk."""
+    from dtc_tpu.utils.checkpoint import CheckpointManager
+
+    mgr = CheckpointManager(str(tmp_path), keep_n=3)
+    for s in (10, 20, 30, 40):
+        mgr.save(s, _mini_state(s))
+    mgr.close()
+
+    # Resume-world: a fresh manager replays past a rollback to 20 and
+    # re-saves 30 while stale 40 is still the on-disk latest.
+    mgr2 = CheckpointManager(str(tmp_path), keep_n=3)
+    mgr2.save(30, _mini_state(77))
+    assert os.path.isdir(mgr2.step_dir(30)), "fresh re-save reaped"
+    assert mgr2.verify_step(30)
+    assert sorted(mgr2.all_steps()) == [20, 30, 40]
+    mgr2.close()
+
+    # keep_n=1 + a stale LATER step: "newest keep_n" alone would delete
+    # the just-saved recovery point and leave only the stale step.
+    root1 = tmp_path / "k1"
+    m = CheckpointManager(str(root1), keep_n=1)
+    for s in (10, 20):
+        m.save(s, _mini_state(s))
+    m.close()
+    m = CheckpointManager(str(root1), keep_n=1)
+    m.save(10, _mini_state(5))  # rollback-to-start replay save
+    assert os.path.isdir(m.step_dir(10)), "current save must survive GC"
+    assert os.path.exists(
+        str(root1 / "manifest_10.json")
+    ), "manifest pruning must exempt the just-saved step too (verify_step "
+    "TRUSTS a manifest-less step — silent integrity stripping otherwise)"
+    assert m.verify_step(10)
+    m.close()
 
 
 def test_sidecars_atomic_and_tolerant_of_torn_files(tmp_path):
