@@ -814,6 +814,7 @@ def fleet_bench(
     shed_watermark: float = 0.75,
     kill_replica_at: int = 0,
     max_wall_s: float = 600.0,
+    obs_dir: str | None = None,
 ) -> dict:
     """One serving-FLEET row (ISSUE 13): Poisson arrivals at ``rps``
     offered requests/s through the tenant-aware router over
@@ -864,7 +865,7 @@ def fleet_bench(
             fleet_target_replica=0,
         ),
     )
-    router = FleetRouter(model, params, rcfg)
+    router = FleetRouter(model, params, rcfg, obs_dir=obs_dir or "")
     rng = np.random.RandomState(seed)
     arrivals = (
         np.zeros(n_requests)
@@ -959,6 +960,8 @@ def serve_fleet_rows(
     shows FLEET backpressure holding typed), and the replica-kill chaos
     leg at 0.9x — failover mid-traffic with zero silent drops, per-
     replica AND fleet percentiles recorded."""
+    import tempfile
+
     n_req = kw.get("n_requests", 48)
     cal = emit("serve_fleet_cal_closed_loop", _safe(
         "serve_fleet_cal_closed_loop",
@@ -974,9 +977,58 @@ def serve_fleet_rows(
         ("load90", 0.9, 0), ("sat300", 3.0, 0), ("kill", 0.9, 8),
     ):
         label = f"serve_fleet_{suffix}"
-        emit(label, _safe(label, lambda f=frac, k=kill: fleet_bench(
+        obs_dir = tempfile.mkdtemp(prefix=f"dtc_bench_{suffix}_")
+        row = emit(label, _safe(label, lambda f=frac, k=kill, d=obs_dir:
+                                fleet_bench(
             cap_rps * f, model_cfg=model_cfg, seed=seed,
-            n_replicas=n_replicas, kill_replica_at=k, **kw)))
+            n_replicas=n_replicas, kill_replica_at=k, obs_dir=d, **kw)))
+        # Goodput companion rows (ISSUE 16): the load and chaos legs
+        # report effective-tokens/s (tokens delivered in COMPLETED
+        # requests over the ledger extent) next to the raw tokens/s,
+        # plus the fleet goodput % and incident count — so a recovery
+        # path that burns wall-clock shows up as a bench number, not
+        # just a log line.
+        if suffix in ("load90", "kill") and "error" not in row:
+            glabel = f"goodput_fleet_{suffix}"
+            emit(glabel, _safe(glabel, lambda r=row, d=obs_dir:
+                               goodput_row_from_obs(d, r)))
+
+
+def goodput_row_from_obs(obs_dir: str, base_row: dict) -> dict:
+    """One ``goodput_*`` row from a leg's event shards: the ledger's
+    fleet goodput %, effective-tokens/s next to the leg's raw tokens/s,
+    the badput split, and the incident bill count. Carries the SAME
+    config fields as its base leg (platform/model/replicas/chaos) so the
+    drift guard's same-config rule can pair rows across rounds."""
+    from dtc_tpu.obs.goodput import GoodputLedger
+
+    s = GoodputLedger.from_dir(obs_dir).summary()
+    if s is None:
+        return {"error": "no classifiable events in obs shards"}
+    tokens = s["tokens"]
+    eff = tokens.get("effective_serve_tokens_per_sec")
+    if eff is None:
+        eff = tokens.get("effective_train_tokens_per_sec")
+    sec = s["fleet"]["seconds"]
+    badput = {
+        k: v for k, v in sorted(sec.items(), key=lambda kv: -kv[1])
+        if k not in ("productive_train", "productive_decode", "prefill")
+    }
+    return {
+        "goodput_pct": s["fleet"]["goodput_pct"],
+        "effective_tokens_per_sec": eff,
+        "raw_tokens_per_sec": base_row.get("sustained_tokens_per_sec"),
+        "effective_serve_tokens": tokens.get("effective_serve_tokens"),
+        "badput_serve_tokens": tokens.get("badput_serve_tokens"),
+        "badput_s": {k: round(v, 4) for k, v in badput.items()},
+        "incidents": len(s["incidents"]),
+        # Same-config drift fields, copied from the measured leg.
+        **{k: base_row.get(k) for k in (
+            "platform", "serve_model", "n_replicas", "kill_replica_at",
+            "slots", "max_new_tokens", "decode_attention",
+            "kv_cache_dtype",
+        )},
+    }
 
 
 def _bench_detail(path: str) -> dict:
@@ -1034,14 +1086,17 @@ def decode_drift_guard(extra: dict, repo_dir: str | None = None) -> list[str]:
     if not paths:
         return flags
 
-    def compare(prefix: str, metric: str, comparable) -> None:
+    def compare(prefix: str, metric: str, comparable,
+                higher_is_better: bool = False) -> None:
         """One guarded row family: walk committed files newest-first,
         stop at the first file holding at least one COMPARABLE row —
         a newest file whose rows are all incomparable (different
         platform/model/config, e.g. TPU rows committed during a CPU
         round) must not deactivate the guard while an older comparable
         file exists — and flag metric regressions > 20%.
-        ``comparable(old, row)`` is the family's same-config rule."""
+        ``comparable(old, row)`` is the family's same-config rule.
+        ``higher_is_better`` flips the regression direction (the goodput
+        family: a DROP in effective-tokens/s is the regression)."""
 
         def has_rows(detail: dict) -> bool:
             return any(
@@ -1067,13 +1122,17 @@ def decode_drift_guard(extra: dict, repo_dir: str | None = None) -> list[str]:
                     continue
                 compared = True
                 new_v, old_v = row.get(metric), old[metric]
-                if (
+                if not (
                     isinstance(new_v, (int, float)) and isinstance(old_v, (int, float))
-                    and new_v and old_v and new_v > 1.2 * old_v
+                    and new_v and old_v
                 ):
+                    continue
+                worse = (new_v < old_v / 1.2 if higher_is_better
+                         else new_v > 1.2 * old_v)
+                if worse:
                     flags.append(
                         f"{label}: {new_v} {metric} vs {old_v} in "
-                        f"{os.path.basename(path)} (+{(new_v / old_v - 1) * 100:.0f}%)"
+                        f"{os.path.basename(path)} ({(new_v / old_v - 1) * 100:+.0f}%)"
                     )
             if compared:
                 return
@@ -1111,6 +1170,14 @@ def decode_drift_guard(extra: dict, repo_dir: str | None = None) -> list[str]:
     compare("fsdp_overlap", "step_time_s", lambda o, r: all(
         o.get(k) == r.get(k) for k in ("collectives", "platform", "devices")
     ))
+    # Goodput rows (ISSUE 16): effective-tokens/s is higher-is-better —
+    # a >20% DROP is the regression. Same-config rule: platform + model
+    # + replica count + the chaos config (kill_replica_at) must all
+    # match, so a clean leg is never judged against a kill leg.
+    compare("goodput", "effective_tokens_per_sec", lambda o, r: all(
+        o.get(k) == r.get(k) for k in (
+            "platform", "serve_model", "n_replicas", "kill_replica_at")
+    ), higher_is_better=True)
 
     if flags:
         extra["decode_regressions"] = flags
@@ -1216,6 +1283,12 @@ def main(argv: list[str] | None = None) -> None:
         "bench still includes them)",
     )
     ap.add_argument(
+        "--fleet-only", action="store_true",
+        help="run ONLY the serving-fleet rows (calibration, load, the "
+        "replica-kill chaos leg) plus their goodput_* companion rows "
+        "(ISSUE 16 — effective-tokens/s next to raw tokens/s)",
+    )
+    ap.add_argument(
         "--devprof-only", action="store_true",
         help="run ONLY the device-time attribution row + trace overhead "
         "(ISSUE 8 — the CPU-measured observatory artifact path while the "
@@ -1265,6 +1338,26 @@ def main(argv: list[str] | None = None) -> None:
                 k: v for k, v in ev.items()
                 if k not in ("etype", "ts", "proc", "label")
             }
+        print("# bench-detail:", json.dumps(extra))
+        reg.close()
+        return
+
+    if args.fleet_only:
+        serve_fleet_rows(emit, seed=args.serve_seed, **serve_cfg_kw)
+        extra = {
+            "devices": jax.device_count(),
+            "device_kind": jax.devices()[0].device_kind,
+            "serve_model": args.serve_model,
+        }
+        for ev in sink.events:
+            if ev["etype"] != "bench_config":
+                continue
+            extra[ev["label"]] = {
+                k: v for k, v in ev.items()
+                if k not in ("etype", "ts", "proc", "label")
+            }
+        for flag in decode_drift_guard(extra):
+            print(f"# DECODE REGRESSION: {flag}")
         print("# bench-detail:", json.dumps(extra))
         reg.close()
         return

@@ -425,6 +425,18 @@ class ObsConfig:
     # hung-step watchdog flag (one window per trigger, warn-and-disable
     # on profiler failure — telemetry never kills the run).
     devprof_on_trigger: bool = True
+    # --- goodput ledger (dtc_tpu/obs/goodput.py, ISSUE 16) ---
+    # Online goodput gauge: runtimes attribute per-class seconds from
+    # timestamps they already take (never a new device sync) into a
+    # sliding window; the current goodput % lands in the `goodput_pct`
+    # gauge and feeds the slo.goodput_min_pct floor objective. The
+    # offline ledger (scripts/goodput_report.py) reads the event shards
+    # regardless of this knob.
+    goodput: bool = True
+    # Emit a `counter` event (Perfetto counter track: goodput % over
+    # time) every N gauge updates (train steps / serve SLO checks).
+    # 0 = gauge only, no counter track.
+    goodput_counter_every: int = 8
 
     def __post_init__(self) -> None:
         if self.memory_sample_every < 0:
@@ -441,6 +453,10 @@ class ObsConfig:
             raise ValueError("devprof_every must be >= 0 (0 = no cadence)")
         if self.devprof_steps < 1:
             raise ValueError("devprof_steps must be >= 1")
+        if self.goodput_counter_every < 0:
+            raise ValueError(
+                "goodput_counter_every must be >= 0 (0 = no counter track)"
+            )
 
 
 @dataclass(frozen=True)
@@ -452,7 +468,11 @@ class SloConfig:
     objective off (the default) no monitor is constructed. Serving
     objectives: ``ttft_p99_s``, ``ms_per_token_p99``,
     ``queue_wait_p99_s``, ``shed_rate``; training objectives:
-    ``step_time_p99_s``, ``data_wait_p99_s``."""
+    ``step_time_p99_s``, ``data_wait_p99_s``. Both runtimes also accept
+    ``goodput_min_pct`` — a FLOOR objective (ISSUE 16): the window mean
+    of the online ``goodput_pct`` gauge must stay >= the threshold, so
+    the breach direction is inverted relative to the latency
+    objectives."""
 
     enabled: bool = True
     window: int = 64        # samples per objective's sliding window
@@ -466,6 +486,8 @@ class SloConfig:
     # -- training objectives (seconds; 0 = off) --
     step_time_p99_s: float = 0.0
     data_wait_p99_s: float = 0.0
+    # -- shared floor objective (percent; 0 = off) --
+    goodput_min_pct: float = 0.0
 
     def __post_init__(self) -> None:
         if self.window < 2:
@@ -480,6 +502,8 @@ class SloConfig:
                 raise ValueError(f"slo {f} must be >= 0 (0 = off)")
         if not 0.0 <= self.shed_rate <= 1.0:
             raise ValueError("slo shed_rate must be in [0, 1] (0 = off)")
+        if not 0.0 <= self.goodput_min_pct <= 100.0:
+            raise ValueError("slo goodput_min_pct must be in [0, 100] (0 = off)")
 
 
 @dataclass(frozen=True)

@@ -19,7 +19,7 @@ import re
 import statistics
 from typing import Any
 
-from dtc_tpu.obs.registry import read_jsonl
+from dtc_tpu.obs.registry import Histogram, read_jsonl
 
 _SHARD_RE = re.compile(r"events\.r(\d+)\.jsonl$")
 
@@ -115,6 +115,11 @@ def _serve_stats(events: list[dict[str, Any]]) -> dict[str, Any] | None:
         "requests": requests, "iterations": iterations,
         "by_state": by_state,
     }
+    # Per-HOST percentiles stay exact nearest-rank over the shard's own
+    # samples; the CROSS-shard pool (below, in reduce_shards) merges
+    # log-bucketed histograms instead of re-deriving from raw samples
+    # (ISSUE 16 satellite) — pooled values are within one ~10% bucket of
+    # the exact nearest-rank answer (the Histogram contract).
     if ttft:
         out["ttft_p50_s"] = r4(nearest_rank(ttft, 0.50))
         out["ttft_p99_s"] = r4(nearest_rank(ttft, 0.99))
@@ -126,8 +131,14 @@ def _serve_stats(events: list[dict[str, Any]]) -> dict[str, Any] | None:
     wall = (ts_hi - ts_lo) if ts_lo is not None else 0.0
     if tokens_done and wall > 0:
         out["tokens_per_sec"] = round(tokens_done / wall, 2)
-    out["_ttft"] = ttft    # cross-shard merge inputs (stripped below)
-    out["_mspt"] = mspt
+    th = Histogram("_ttft")
+    mh = Histogram("_mspt")
+    for v in ttft:
+        th.observe(v)
+    for v in mspt:
+        mh.observe(v)
+    out["_ttft_hist"] = th  # cross-shard merge inputs (stripped below)
+    out["_mspt_hist"] = mh
     out["_tokens_done"] = tokens_done
     out["_ts"] = (ts_lo, ts_hi)
     return out
@@ -240,8 +251,10 @@ def reduce_shards(
     per_host: dict[int, dict[int, float]] = {}
     serve_host: dict[int, dict[str, Any]] = {}
     elastic_host: dict[int, dict[str, Any]] = {}
+    events_by_proc: dict[int, list[dict[str, Any]]] = {}
     for proc, path in sorted(shards.items()):
         events = read_jsonl(path)
+        events_by_proc[proc] = events
         times = _step_times(events)
         if times:
             per_host[proc] = times
@@ -251,6 +264,16 @@ def reduce_shards(
         elastic = _elastic_stats(events)
         if elastic is not None:
             elastic_host[proc] = elastic
+    # Goodput ledger (ISSUE 16): re-classify every host's wall-clock
+    # from the same shard events — per-host tables, fleet pool, token
+    # ledger, incident bills. None when no shard yields intervals.
+    goodput_total: dict[str, Any] | None = None
+    try:
+        from dtc_tpu.obs.goodput import GoodputLedger
+
+        goodput_total = GoodputLedger(events_by_proc).summary()
+    except Exception as e:  # reduction must never kill the run's summary
+        print(f"[dtc_tpu] WARNING: goodput reduction failed ({e})")
     elastic_total: dict[str, Any] | None = None
     if elastic_host:
         # Cross-shard merge: counters sum, event lists concatenate (each
@@ -271,19 +294,19 @@ def reduce_shards(
                     elastic_total.setdefault(k, []).extend(s[k])
     serve_total = None
     if serve_host:
-        from dtc_tpu.utils.percentile import nearest_rank, round_opt as r4
+        from dtc_tpu.utils.percentile import round_opt as r4
 
         by_state: dict[str, int] = {}
-        all_ttft: list[float] = []
-        all_mspt: list[float] = []
+        pool_ttft = Histogram("_pool_ttft")
+        pool_mspt = Histogram("_pool_mspt")
         tokens_done = 0
         ts_lo: float | None = None
         ts_hi: float | None = None
         for s in serve_host.values():
             for k, v in s["by_state"].items():
                 by_state[k] = by_state.get(k, 0) + v
-            all_ttft.extend(s.pop("_ttft"))
-            all_mspt.extend(s.pop("_mspt"))
+            pool_ttft.merge(s.pop("_ttft_hist"))
+            pool_mspt.merge(s.pop("_mspt_hist"))
             tokens_done += s.pop("_tokens_done")
             lo, hi = s.pop("_ts")
             if lo is not None:
@@ -297,13 +320,16 @@ def reduce_shards(
         # Fleet-level SLO surface: percentiles over the POOLED terminals
         # (not a mean of per-replica percentiles — that would hide the
         # failover tail inside the averaging) + a tokens/s estimate over
-        # the fleet's event-time span.
-        if all_ttft:
-            serve_total["ttft_p50_s"] = r4(nearest_rank(all_ttft, 0.50))
-            serve_total["ttft_p99_s"] = r4(nearest_rank(all_ttft, 0.99))
-        if all_mspt:
-            serve_total["ms_per_token_p50"] = r4(nearest_rank(all_mspt, 0.50))
-            serve_total["ms_per_token_p99"] = r4(nearest_rank(all_mspt, 0.99))
+        # the fleet's event-time span. Pooling merges the per-shard
+        # log-bucketed histograms (bucket counts sum — ISSUE 16
+        # satellite), so the pool never re-walks raw samples and the
+        # answer is within one ~10% bucket of exact nearest-rank.
+        if pool_ttft.count:
+            serve_total["ttft_p50_s"] = r4(pool_ttft.percentile(0.50))
+            serve_total["ttft_p99_s"] = r4(pool_ttft.percentile(0.99))
+        if pool_mspt.count:
+            serve_total["ms_per_token_p50"] = r4(pool_mspt.percentile(0.50))
+            serve_total["ms_per_token_p99"] = r4(pool_mspt.percentile(0.99))
         wall = (ts_hi - ts_lo) if ts_lo is not None else 0.0
         if tokens_done and wall > 0:
             serve_total["tokens_per_sec"] = round(tokens_done / wall, 2)
@@ -337,6 +363,8 @@ def reduce_shards(
         }
         if elastic_total is not None:
             out["elastic"] = elastic_total
+        if goodput_total is not None:
+            out["goodput"] = goodput_total
         return out
 
     host_means = {
@@ -385,4 +413,6 @@ def reduce_shards(
         out["serve"] = serve_total
     if elastic_total is not None:
         out["elastic"] = elastic_total
+    if goodput_total is not None:
+        out["goodput"] = goodput_total
     return out

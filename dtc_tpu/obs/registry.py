@@ -123,6 +123,25 @@ class Histogram:
                 return min(max(mid, self.min), self.max)
         return self.max  # unreachable: counts always cover rank
 
+    def merge(self, other: "Histogram") -> "Histogram":
+        """Fold ``other``'s observations into this histogram, in place.
+
+        Bucket counts sum (both sides use the same fixed log-bucket
+        layout, so a merged histogram's ``percentile`` equals a single
+        histogram fed the concatenated samples — exactly, not within a
+        bucket; the unit tests pin this). This is how the cross-shard
+        reducer pools per-replica latency distributions without
+        re-deriving them from raw ``serve_request`` samples."""
+        self.count += other.count
+        self.total += other.total
+        if other.min is not None:
+            self.min = other.min if self.min is None else min(self.min, other.min)
+        if other.max is not None:
+            self.max = other.max if self.max is None else max(self.max, other.max)
+        for idx, n in other._buckets.items():
+            self._buckets[idx] = self._buckets.get(idx, 0) + n
+        return self
+
     def summary(self) -> dict[str, float | int | None]:
         return {
             "count": self.count,

@@ -29,8 +29,10 @@ from dtc_tpu.utils.percentile import nearest_rank
 @dataclass(frozen=True)
 class Objective:
     """One SLO: ``kind`` "quantile" (nearest-rank ``q`` of the sampled
-    ``metric`` must stay <= ``threshold``) or "rate" (fraction of True
-    outcomes in the window must stay <= ``threshold``)."""
+    ``metric`` must stay <= ``threshold``), "rate" (fraction of True
+    outcomes in the window must stay <= ``threshold``), or "floor"
+    (window mean of the metric must stay >= ``threshold`` — the goodput
+    objective, where LOW is the failure direction)."""
 
     name: str          # e.g. "ttft_p99_s" — the knob/event label
     metric: str        # sample stream key, e.g. "serve_ttft_s"
@@ -45,10 +47,12 @@ _SERVE_OBJECTIVES = {
     "ms_per_token_p99": ("serve_ms_per_token", "quantile"),
     "queue_wait_p99_s": ("serve_queue_wait_s", "quantile"),
     "shed_rate": ("serve_outcome_shed", "rate"),
+    "goodput_min_pct": ("goodput_pct", "floor"),
 }
 _TRAIN_OBJECTIVES = {
     "step_time_p99_s": ("step_time_s", "quantile"),
     "data_wait_p99_s": ("data_wait_s", "quantile"),
+    "goodput_min_pct": ("goodput_pct", "floor"),
 }
 
 
@@ -118,7 +122,7 @@ class SloMonitor:
         vals = self._samples[obj.metric]
         if len(vals) < self.min_samples:
             return None
-        if obj.kind == "rate":
+        if obj.kind in ("rate", "floor"):
             return sum(vals) / len(vals)
         return nearest_rank(vals, obj.q)
 
@@ -128,7 +132,10 @@ class SloMonitor:
         breaches = []
         for obj in self.objectives:
             cur = self.current(obj)
-            breaching = cur is not None and cur > obj.threshold
+            if obj.kind == "floor":
+                breaching = cur is not None and cur < obj.threshold
+            else:
+                breaching = cur is not None and cur > obj.threshold
             record = {
                 "objective": obj.name, "metric": obj.metric,
                 "kind": obj.kind, "value": None if cur is None else round(cur, 6),

@@ -40,7 +40,11 @@ Event stream schema (JSONL, one shard per process — see README
                      of the trailing median step time (``runtime: serve``
                      when the serving scheduler's watchdog flagged it);
 - ``run_summary``  — totals: tokens/s, MFU, peak HBM, compile/recompile
-                     counts, est. comm bytes per step.
+                     counts, est. comm bytes per step;
+- ``counter``      — online goodput gauge sample (``name``: goodput_pct,
+                     ``value``) — the Perfetto counter track (ISSUE 16);
+                     the offline truth is the goodput ledger
+                     (``dtc_tpu/obs/goodput.py``) over this same stream.
 
 Serving events (``dtc_tpu/serve/`` — SLO accounting rides the same
 registry: ``serve_queue_wait_s`` / ``serve_ttft_s`` /
@@ -77,6 +81,7 @@ from dtc_tpu.obs.aggregate import reduce_shards, shard_path
 from dtc_tpu.obs.device import peak_hbm_bytes, sample_memory
 from dtc_tpu.obs.devprof import DeviceProfiler
 from dtc_tpu.obs.profiling import StepWindowProfiler
+from dtc_tpu.obs.goodput import OnlineGoodput
 from dtc_tpu.obs.registry import CsvSink, JsonlSink, MetricsRegistry
 from dtc_tpu.obs.slo import SloMonitor
 from dtc_tpu.obs.stepclock import CompileWatcher, StepClock
@@ -144,6 +149,16 @@ class Telemetry:
             slo_cfg, self.registry, runtime="train"
         )
         self._slo_check_every = getattr(slo_cfg, "check_every", 8) or 8
+        # Online goodput gauge (ISSUE 16): fed per-class seconds from
+        # the step breakdown / the serving scheduler's iteration clock —
+        # timestamps already taken, never a new sync. The serving engine
+        # shares this instance (its registry IS this registry).
+        self.goodput: OnlineGoodput | None = None
+        if self.cfg.enabled and getattr(self.cfg, "goodput", True):
+            self.goodput = OnlineGoodput(
+                self.registry,
+                counter_every=getattr(self.cfg, "goodput_counter_every", 8),
+            )
         # Device-time observatory (ISSUE 8): programmatic jax.profiler
         # capture windows — cadence via obs.devprof_every, on-demand via
         # request_device_profile(), plus the SLO-breach / hung-step
@@ -293,6 +308,21 @@ class Telemetry:
                     "compile", t1 - compile_s, t1, cat="train",
                     tid="train.compile", step=step, recompile=True,
                 )
+        if self.goodput is not None:
+            # Per-class attribution from numbers the clock already
+            # measured: compile and data-wait seconds are badput, the
+            # remainder of the step is productive training.
+            dw = breakdown["data_wait_s"]
+            cs = float(extra.get("compile_s", 0.0) or 0.0)
+            self.goodput.note("data_wait", dw)
+            self.goodput.note("compile", cs)
+            self.goodput.note(
+                "productive_train",
+                max(breakdown["step_time_s"] - dw - cs, 0.0),
+            )
+            pct = self.goodput.update(step=step)
+            if self.slo is not None:
+                self.slo.observe("goodput_pct", pct)
         if self.slo is not None:
             self.slo.observe("step_time_s", breakdown["step_time_s"])
             self.slo.observe("data_wait_s", breakdown["data_wait_s"])
@@ -424,6 +454,26 @@ class Telemetry:
     def on_recovery(self, step: int, *, action: str, **fields: Any) -> None:
         self.registry.counter("recoveries").inc()
         self.registry.emit("recovery", step=step, action=action, **fields)
+        self._note_restore_badput(
+            "rollback_replay" if action == "rollback" else "degraded",
+            fields, step,
+        )
+
+    def _note_restore_badput(
+        self, klass: str, fields: dict[str, Any], step: int
+    ) -> None:
+        """Feed the online gauge the detect->restored gap when the event
+        carries the enriched timestamps (the offline ledger additionally
+        bills the discarded step executions — too retroactive for a
+        streaming gauge)."""
+        if self.goodput is None:
+            return
+        td, tr = fields.get("t_detect"), fields.get("t_restored")
+        if isinstance(td, (int, float)) and isinstance(tr, (int, float)):
+            self.goodput.note(klass, max(float(tr) - float(td), 0.0))
+            pct = self.goodput.update(step=step)
+            if self.slo is not None:
+                self.slo.observe("goodput_pct", pct)
 
     def on_elastic(self, step: int, kind: str, **fields: Any) -> None:
         """Typed elastic-layer events (ISSUE 15): ``host_lost`` /
@@ -435,6 +485,8 @@ class Telemetry:
         name = kind if kind.startswith("elastic_") else f"elastic_{kind}"
         self.registry.counter(name).inc()
         self.registry.emit(kind, step=step, **fields)
+        if kind == "elastic_resize":
+            self._note_restore_badput("elastic_resize", fields, step)
         if kind == "host_lost":
             self.dump_flight("host_lost", step=step)
 

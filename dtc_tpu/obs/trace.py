@@ -243,6 +243,10 @@ _INSTANT_ETYPES = frozenset({
     # trace_report waterfall shows recovery where it happened.
     "snapshot", "host_lost", "host_slow", "elastic_resize",
     "elastic_spill",
+    # Goodput ledger (ISSUE 16): recovery-path compile drains — the
+    # recompile cost an incident bill attributes — get a mark where they
+    # happened instead of vanishing from the timeline.
+    "aux_compile",
 })
 
 
@@ -272,7 +276,7 @@ def to_chrome_trace(events: list[dict[str, Any]]) -> dict[str, Any]:
         if t is None:
             continue
         etype = e.get("etype")
-        if etype == "span" or etype in _INSTANT_ETYPES:
+        if etype == "span" or etype == "counter" or etype in _INSTANT_ETYPES:
             if base is None or t < base:
                 base = t
     if base is None:
@@ -289,6 +293,19 @@ def to_chrome_trace(events: list[dict[str, Any]]) -> dict[str, Any]:
             name = str(e.get("name", "span"))
             ph = "X" if e.get("ph", "X") == "X" else "i"
             dur = float(e.get("dur_s", 0.0) or 0.0)
+        elif etype == "counter":
+            # Perfetto counter track (ISSUE 16): the online goodput
+            # gauge's periodic samples render as a value-over-time
+            # track next to the span timeline.
+            name = str(e.get("name", "counter"))
+            v = e.get("value")
+            rows.append((round((t - base) * 1e6, 1), {
+                "name": name, "ph": "C",
+                "ts": round((t - base) * 1e6, 1), "dur": 0.0,
+                "pid": pid, "tid": tid_for(pid, name), "cat": "counter",
+                "args": {name: float(v) if isinstance(v, (int, float)) else 0.0},
+            }))
+            continue
         elif etype in _INSTANT_ETYPES:
             # Attach to the owning request's track when the event names
             # one — evictions/chaos/corruption land on the request row.

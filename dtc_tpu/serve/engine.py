@@ -68,6 +68,7 @@ from dtc_tpu.adapters import (
     validate_lora_tree,
 )
 from dtc_tpu.generate import decode_step, init_cache
+from dtc_tpu.obs.goodput import OnlineGoodput
 from dtc_tpu.obs.registry import MetricsRegistry
 from dtc_tpu.obs.slo import SloMonitor
 from dtc_tpu.obs.trace import FlightRecorder, Tracer
@@ -192,6 +193,15 @@ class ServingEngine:
         slo_cfg = getattr(cfg, "slo", None)
         self.slo = SloMonitor.from_config(slo_cfg, self.reg, runtime="serve")
         self._slo_check_every = getattr(slo_cfg, "check_every", 8) or 8
+        # Online goodput gauge (ISSUE 16): share the telemetry facade's
+        # instance (its registry IS this registry), or a private one for
+        # bare engines (tests, bench). Fed below from the iteration
+        # timestamps the scheduler already takes — never a device sync.
+        self.goodput: OnlineGoodput | None = (
+            getattr(telemetry, "goodput", None)
+            if telemetry is not None else OnlineGoodput(self.reg)
+        )
+        self._gp_work = 0.0  # attributed seconds, current iteration
         self.bus = RecoveryBus()
         self.chaos = (
             ChaosInjector(cfg.chaos, self.bus) if cfg.chaos.enabled else None
@@ -688,6 +698,7 @@ class ServingEngine:
         request is queued or in flight."""
         self._it += 1
         self._worked = False  # set by _do_admit/_decode (model ran)
+        self._gp_work = 0.0
         t0 = self.clock()
         if self.chaos is not None:
             stall = self.chaos.serve_stall(self._it)
@@ -728,8 +739,24 @@ class ServingEngine:
         # flight dumps fire) first, so a stall-then-flag iteration's LAST
         # dump carries the most diagnostic reason (hung_step).
         self._drain_bus()
+        now_it = self.clock()
+        if self.goodput is not None:
+            # The iteration's unattributed remainder (scheduler
+            # bookkeeping, chaos stalls, pure polling spins) is idle —
+            # or degraded while a latency objective is breaching.
+            idle = max((now_it - t0) - self._gp_work, 0.0)
+            self.goodput.note(
+                "degraded"
+                if self.slo is not None and self.slo.degrade_active
+                else "shed_or_idle",
+                idle,
+            )
+            if self._it % self._slo_check_every == 0:
+                pct = self.goodput.update(iteration=self._it)
+                if self.slo is not None:
+                    self.slo.observe("goodput_pct", pct)
         if self.watchdog is not None and self._worked:
-            flag = self.watchdog.observe(self._it, self.clock() - t0)
+            flag = self.watchdog.observe(self._it, now_it - t0)
             if flag is not None:
                 self.reg.counter("serve_hung_steps").inc()
                 self.reg.emit("hung_step", runtime="serve", **flag)
@@ -1139,6 +1166,15 @@ class ServingEngine:
             tid=req.rid, rid=req.rid,
             resident=len(seq), prefix_len=base_len, slot=slot_i,
         )
+        if self.goodput is not None:
+            # A re-prefill after an eviction or a failover hop is the
+            # incident's recompute, not fresh productive prefill.
+            self.goodput.note(
+                "failover_replay"
+                if (res.n_evictions or res.n_hops) else "prefill",
+                now - t_adm,
+            )
+            self._gp_work += now - t_adm
         self.last_tok[slot_i] = tok
         self.reg.counter("serve_admissions").inc()
         self.reg.emit(
@@ -1247,6 +1283,9 @@ class ServingEngine:
             "decode_step", self._ts(t_dec), self._ts(now), cat="serve",
             tid="sched", iteration=self._it, batch=len(active),
         )
+        if self.goodput is not None:
+            self.goodput.note("productive_decode", now - t_dec)
+            self._gp_work += now - t_dec
         completed_pages = []  # (slot_i, page) finished this step
         for i, rid in active:
             slot = self.slots[i]

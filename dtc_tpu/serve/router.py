@@ -461,11 +461,18 @@ class FleetRouter:
         rep = self.replicas[replica_id]
         if rep.state is ReplicaState.DEAD:
             return
+        # Goodput ledger (ISSUE 16): stamp detection before the failover
+        # work starts — the incident bill's wall window opens here.
+        t_detect = self.clock()
         self.reg.counter("router_replica_deaths").inc()
         self._transition(rep, ReplicaState.DEAD, reason)
-        self._failover(rep)
+        self._failover(rep, t_detect=t_detect)
 
-    def _failover(self, dead: EngineReplica) -> None:
+    def _failover(
+        self, dead: EngineReplica, t_detect: float | None = None
+    ) -> None:
+        if t_detect is None:
+            t_detect = self.clock()
         orphans = [
             (rid, rec) for rid, rec in self.records.items()
             if rec.replica == dead.replica_id
@@ -499,10 +506,17 @@ class FleetRouter:
             rec.replica = rep.replica_id
             rec.hops += 1
             self.reg.counter("router_failovers").inc()
+            # t_restored: the orphan is re-homed and resubmitted — the
+            # survivor's re-prefill (billed separately, from its span)
+            # starts after this. Both stamps are clock reads this path
+            # already pays for; the ledger stops inferring the window
+            # from neighboring spans.
             self.reg.emit(
                 "router_failover", rid=rid, src=prev,
                 dst=rep.replica_id, tokens_carried=len(rec.tokens),
                 hop=rec.hops, iteration=self._it,
+                t_detect=round(t_detect, 6),
+                t_restored=round(self.clock(), 6),
             )
 
     def _terminate(

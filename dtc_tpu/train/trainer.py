@@ -933,6 +933,11 @@ def _train(
             itself, whose update no observed loss has vouched for (see
             the comment at the ``latest`` call)."""
             nonlocal state, data_it
+            # Goodput ledger (ISSUE 16): the detect->restored gap is a
+            # wall-clock read at each end of work this path does anyway —
+            # no new device syncs, and the ledger no longer has to infer
+            # the window from neighboring spans.
+            t_detect = time.time()
             # A step's loss is computed on the params going INTO it
             # (value_and_grad before the update), so the previous
             # window's healthy losses — through step `boundary` —
@@ -962,6 +967,7 @@ def _train(
             tele.on_recovery(
                 cur_step, action="rollback", to_step=target, reason=reason,
                 tier=tier, rollbacks=guard.rollbacks_done,
+                t_detect=round(t_detect, 6), t_restored=round(time.time(), 6),
             )
             tele.drain_recovery_bus(bus, cur_step)
             # The restore's host transfers may compile tiny executables —
@@ -995,6 +1001,11 @@ def _train(
             nonlocal result_base, eval_fn, eval_set, snap_dispatch_cold
             from dtc_tpu.resilience.elastic import shrink_mesh
             from dtc_tpu.resilience.errors import ElasticAbort
+
+            # Goodput ledger (ISSUE 16): explicit detect/restored stamps
+            # — wall-clock reads on a path that just lost a host, never
+            # a new sync in the hot loop.
+            t_detect = time.time()
 
             new_mesh = shrink_mesh(mesh, hosts)
             new_data = int(new_mesh.shape["data"])
@@ -1065,6 +1076,7 @@ def _train(
                 devices=num_devices,
                 mesh={k: int(v) for k, v in mesh.shape.items()},
                 per_device_batch=train_cfg.batch // new_data,
+                t_detect=round(t_detect, 6), t_restored=round(time.time(), 6),
             )
             tele.drain_recovery_bus(bus, cur_step)
             # Spill the restored state to the cold tier immediately: a

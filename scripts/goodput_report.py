@@ -1,0 +1,235 @@
+#!/usr/bin/env python
+"""Goodput ledger report (ISSUE 16): where every second and token went.
+
+Reads a run's telemetry shards (``<run>/obs/events.r*.jsonl``) and
+re-classifies each host's wall-clock into the closed goodput taxonomy
+(productive_train / productive_decode / prefill / data_wait / compile /
+snapshot_commit / rollback_replay / elastic_resize / failover_replay /
+shed_or_idle / degraded / unattributed), then prints:
+
+- **per-host table**: wall-clock, goodput %, unattributed %, and the
+  per-class seconds for every host/replica shard.
+- **incident bills**: one row per rollback / elastic resize / failover /
+  eviction — detection-to-restore wall, replay seconds, recompile
+  seconds, and the tokens the incident burned.
+- **badput waterfall**: non-productive seconds by (class, cause),
+  largest first — the "what would fixing X buy" view.
+- **token ledger**: effective train tokens (steps that survived into
+  final state), effective serve tokens (delivered in COMPLETED
+  requests), and the badput token counts, with effective-tokens/s.
+- **--compare OTHER_RUN**: side-by-side goodput % / per-class seconds /
+  effective-tokens/s deltas between two runs.
+
+Wall-clocks on CPU hosts are shape-only — the report's value there is
+the *classification* (does every second carry a cause?), not absolute
+throughput.
+
+    python scripts/goodput_report.py outputs/run1
+        [--json] [--compare outputs/run2]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from dtc_tpu.obs.aggregate import find_shards  # noqa: E402
+from dtc_tpu.obs.goodput import CLASSES, GoodputLedger  # noqa: E402
+
+
+def resolve_obs_dir(run_dir: str) -> str:
+    """Accept either the run's output dir or its obs/ dir directly."""
+    if find_shards(run_dir):
+        return run_dir
+    sub = os.path.join(run_dir, "obs")
+    if find_shards(sub):
+        return sub
+    raise SystemExit(
+        f"no events.r*.jsonl under {run_dir} or {run_dir}/obs — was the "
+        "run's obs.jsonl telemetry enabled?"
+    )
+
+
+def load_ledger(run_dir: str) -> GoodputLedger:
+    return GoodputLedger.from_dir(resolve_obs_dir(run_dir))
+
+
+# ---------------------------------------------------------------------------
+# report sections
+
+
+def print_host_table(summary: dict) -> None:
+    hosts = summary.get("hosts") or {}
+    if not hosts:
+        print("no classifiable intervals (telemetry off, or an empty run)")
+        return
+    # Only print class columns that any host actually used.
+    used = [
+        k for k in CLASSES
+        if any(h["seconds"].get(k) for h in hosts.values())
+    ]
+    hdr = f"{'host':<6}{'kind':<7}{'wall_s':>9}{'good%':>7}{'unatt%':>7}" + "".join(
+        f"{k[:12]:>13}" for k in used
+    )
+    print(hdr)
+    print("-" * len(hdr))
+    for proc in sorted(hosts, key=lambda p: (len(p), p)):
+        h = hosts[proc]
+        print(
+            f"{proc:<6}{h['kind']:<7}{h['wall_s']:>9.3f}"
+            f"{h['goodput_pct']:>7.1f}{h['unattributed_pct']:>7.1f}"
+            + "".join(f"{h['seconds'].get(k, 0.0):>13.3f}" for k in used)
+        )
+    fleet = summary["fleet"]
+    print(
+        f"{'fleet':<6}{'':<7}{fleet['wall_s']:>9.3f}"
+        f"{fleet['goodput_pct']:>7.1f}{'':>7}"
+        + "".join(f"{fleet['seconds'].get(k, 0.0):>13.3f}" for k in used)
+    )
+
+
+def print_incident_bills(summary: dict) -> None:
+    incidents = summary.get("incidents") or []
+    if not incidents:
+        print("\nno incidents (clean run)")
+        return
+    # Detection times print relative to the first incident — absolute
+    # wall-clocks (epoch seconds on the trainer) are unreadable here.
+    t0 = min((i["t_detect"] for i in incidents
+              if i.get("t_detect") is not None), default=0.0)
+    hdr = (f"\n{'incident':<15}{'proc':>5}{'detect+s':>10}{'restore_s':>10}"
+           f"{'replay_s':>10}{'recomp_s':>10}{'wall_s':>9}{'tok_bad':>9}  why")
+    print(hdr)
+    print("-" * len(hdr))
+    for inc in incidents:
+        why = inc.get("reason") or inc.get("rid") or ""
+        det = inc.get("t_detect")
+        det_s = "-" if det is None else f"{det - t0:.3f}"
+        print(
+            f"{inc['kind']:<15}{inc['proc']:>5}{det_s:>10}"
+            f"{inc['restore_s']:>10.4f}{inc['replay_s']:>10.4f}"
+            f"{inc['recompile_s']:>10.4f}{inc['wall_s']:>9.4f}"
+            f"{inc['tokens_badput']:>9}  {why}"
+        )
+
+
+def print_waterfall(summary: dict) -> None:
+    rows = summary.get("badput_waterfall") or []
+    if not rows:
+        print("\nno badput — every attributed second was productive")
+        return
+    total = sum(r["seconds"] for r in rows) or 1e-9
+    print(f"\nbadput waterfall ({total:.3f}s non-productive):")
+    width = 36
+    for r in rows:
+        bar = "#" * max(int(r["seconds"] / total * width), 1)
+        label = (f"{r['class']}:{r['cause']}"
+                 if r["cause"] != r["class"] else r["class"])
+        print(f"  {label:<34}{r['seconds']:>10.3f}s |{bar:<{width}}|")
+
+
+def print_tokens(summary: dict) -> None:
+    tok = summary.get("tokens") or {}
+    if not tok:
+        return
+    print("\ntoken ledger:")
+    for k in ("tokens_per_step", "effective_train_tokens",
+              "badput_train_tokens", "effective_serve_tokens",
+              "badput_serve_tokens", "effective_train_tokens_per_sec",
+              "effective_serve_tokens_per_sec"):
+        if tok.get(k) is not None:
+            print(f"  {k:<32}{tok[k]}")
+
+
+def print_report(summary: dict) -> None:
+    print_host_table(summary)
+    print_incident_bills(summary)
+    print_waterfall(summary)
+    print_tokens(summary)
+
+
+# ---------------------------------------------------------------------------
+# compare
+
+
+def compare_summaries(a: dict, b: dict) -> list[dict]:
+    """Per-class seconds + headline deltas, A -> B."""
+    rows = [{
+        "metric": "goodput_pct",
+        "a": a["fleet"]["goodput_pct"],
+        "b": b["fleet"]["goodput_pct"],
+    }, {
+        "metric": "wall_s",
+        "a": a["fleet"]["wall_s"],
+        "b": b["fleet"]["wall_s"],
+    }]
+    for k in CLASSES:
+        va = a["fleet"]["seconds"].get(k, 0.0)
+        vb = b["fleet"]["seconds"].get(k, 0.0)
+        if va or vb:
+            rows.append({"metric": f"seconds.{k}", "a": va, "b": vb})
+    for k in ("effective_train_tokens", "effective_serve_tokens",
+              "effective_train_tokens_per_sec",
+              "effective_serve_tokens_per_sec"):
+        va = (a.get("tokens") or {}).get(k)
+        vb = (b.get("tokens") or {}).get(k)
+        if va is not None or vb is not None:
+            rows.append({"metric": f"tokens.{k}", "a": va, "b": vb})
+    for r in rows:
+        if r["a"] and r["b"] is not None:
+            r["delta_pct"] = round((r["b"] / r["a"] - 1) * 100, 1)
+    return rows
+
+
+def print_compare(rows: list[dict]) -> None:
+    hdr = f"{'metric':<40}{'A':>14}{'B':>14}{'delta%':>9}"
+    print(hdr)
+    print("-" * len(hdr))
+    fmt = lambda v: "-" if v is None else f"{v:.3f}"  # noqa: E731
+    for r in rows:
+        print(
+            f"{r['metric']:<40}{fmt(r['a']):>14}{fmt(r['b']):>14}"
+            f"{r.get('delta_pct', '-'):>9}"
+        )
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[1])
+    ap.add_argument("run_dir", help="run output dir (or its obs/ dir)")
+    ap.add_argument("--json", action="store_true",
+                    help="dump the raw summary dict instead of tables")
+    ap.add_argument("--compare", metavar="RUN_B", default="",
+                    help="diff the goodput summary against a second run")
+    args = ap.parse_args(argv)
+
+    ledger = load_ledger(args.run_dir)
+    summary = ledger.summary()
+    if summary is None:
+        raise SystemExit(
+            f"no classifiable events under {args.run_dir} — goodput needs "
+            "the ISSUE 1/7 event streams (obs.jsonl on)"
+        )
+
+    if args.compare:
+        other = load_ledger(args.compare).summary()
+        if other is None:
+            raise SystemExit(f"no classifiable events under {args.compare}")
+        print_compare(compare_summaries(summary, other))
+        return 0
+
+    if args.json:
+        print(json.dumps(summary, indent=1, sort_keys=True))
+        return 0
+
+    n_hosts = len(summary.get("hosts") or {})
+    print(f"# goodput ledger: {n_hosts} host shard(s) under {args.run_dir}")
+    print_report(summary)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

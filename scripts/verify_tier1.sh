@@ -16,8 +16,8 @@ timeout -k 10 120 env JAX_PLATFORMS=cpu python -m pytest --collect-only -q -p no
   tests/test_analysis.py tests/test_numerics.py tests/test_bf16.py \
   tests/test_serve.py tests/test_trace.py tests/test_devprof.py \
   tests/test_adapters.py tests/test_overlap_collectives.py \
-  tests/test_router.py tests/test_elastic.py > /dev/null || {
-    echo "tier-1 pre-gate: MoE/HLO/decode/analysis/serve/trace/devprof/adapters/overlap/router/elastic test collection failed" >&2; exit 1; }
+  tests/test_router.py tests/test_elastic.py tests/test_goodput.py > /dev/null || {
+    echo "tier-1 pre-gate: MoE/HLO/decode/analysis/serve/trace/devprof/adapters/overlap/router/elastic/goodput test collection failed" >&2; exit 1; }
 # Pre-gate 2 (ISSUE 5 + 6): the graph audit — lower/compile the
 # dp/tp/fsdp/ep train steps (8-virtual-device CPU mesh), the greedy decode
 # scan, AND the serving (continuous-batching) decode step; run the rule
@@ -107,4 +107,14 @@ timeout -k 10 300 env JAX_PLATFORMS=cpu python scripts/fleet_smoke.py || {
 # ONE recompile at the first replayed step. ~1-2 min.
 timeout -k 10 300 env JAX_PLATFORMS=cpu python scripts/elastic_smoke.py || {
     echo "tier-1 pre-gate: elastic-training smoke failed" >&2; exit 1; }
+# Pre-gate 9 (ISSUE 16): goodput-ledger smoke — a 6-step train run with a
+# chaos NaN at step 3 (rollback + replay through the real guard) and a
+# 2-request serve run, then the ledger leg: the goodput report must
+# render from the shards alone, per-host interval sums must reconcile
+# with wall-clock within 1% (unattributed <= 5%), the rollback incident
+# bill must carry t_detect/t_restored + the discarded step's tokens,
+# the reducer must attach a `goodput` section, and the Perfetto export
+# must carry the goodput_pct counter track (ph "C"). ~1-2 min.
+timeout -k 10 300 env JAX_PLATFORMS=cpu python scripts/goodput_smoke.py || {
+    echo "tier-1 pre-gate: goodput-ledger smoke failed" >&2; exit 1; }
 set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc

@@ -59,6 +59,9 @@ _QUICK_EXCLUDE_FILES = {
     "test_adapters.py",
     # Drives full elastic kill/shrink chaos training runs (ISSUE 15).
     "test_elastic.py",
+    # Drives the goodput chaos acceptance run: a NaN-rollback training
+    # run plus a replica-kill fleet run in one test (ISSUE 16).
+    "test_goodput.py",
 }
 
 

@@ -1,0 +1,509 @@
+"""Goodput-ledger tests (ISSUE 16): wall-clock & token accounting.
+
+The fixture tests hand-build event timelines with known arithmetic and
+pin EXACT per-class seconds, effective-token counts, and incident bills
+— the ledger's claim is "every second attributed, nothing double-
+counted", so the assertions are equalities, not tolerances. The chaos
+acceptance test then drives the REAL trainer (chaos NaN -> rollback ->
+replay) and the REAL fleet router (replica kill -> failover re-prefill)
+and checks the reconciliation gates: per-host interval sums match
+wall-clock within 1%, ``unattributed`` stays under 5%, and every badput
+second carries a typed cause.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from dtc_tpu.config.schema import (
+    ChaosConfig,
+    MeshConfig,
+    ModelConfig,
+    OptimConfig,
+    ResilienceConfig,
+    RouterConfig,
+    ServeConfig,
+    SloConfig,
+    StreamRetryConfig,
+    TrainConfig,
+)
+from dtc_tpu.obs import MemorySink, reduce_shards, shard_path
+from dtc_tpu.obs.goodput import (
+    CLASSES,
+    PRODUCTIVE,
+    TYPED_BADPUT,
+    UNATTRIBUTED,
+    GoodputLedger,
+    OnlineGoodput,
+)
+from dtc_tpu.obs.registry import Histogram, MetricsRegistry
+from dtc_tpu.obs.slo import Objective, SloMonitor
+from dtc_tpu.obs.trace import to_chrome_trace
+
+
+# ---------------------------------------------------------------------------
+# hand-built train fixture: one rollback, exact arithmetic
+# ---------------------------------------------------------------------------
+
+def _train_fixture_events():
+    """6.9 s of trainer timeline, batch 4 x seq 8 (32 tokens/step):
+
+    [0.0, 1.0]  compile (startup)
+    [1.0, 1.2]  data_wait   (step 1 head)
+    [1.2, 2.0]  productive  (step 1)
+    [2.0, 3.0]  productive  (step 2)
+    [3.0, 4.0]  step 3 first execution — DISCARDED by the rollback
+    [4.0, 5.0]  rollback restore (t_detect=4.0 -> t_restored=5.0)
+    [5.0, 5.6]  productive  (step 3 replay)
+    [5.6, 6.1]  compile     (step 3 replay's recompile tail, 0.5 s)
+    [6.1, 7.1]  productive  (step 4)
+    [7.1, 7.5]  snapshot_commit (checkpoint span)
+    [7.5, 7.9]  compile     (aux_compile what=rollback, billed to incident)
+    """
+    return [
+        {"etype": "run_start", "ts": 0.0, "batch": 4, "seq_len": 8},
+        {"etype": "compile", "ts": 1.0, "step": 0, "compile_time_s": 1.0},
+        {"etype": "step", "ts": 2.0, "step": 1, "step_time_s": 1.0,
+         "data_wait_s": 0.2},
+        {"etype": "step", "ts": 3.0, "step": 2, "step_time_s": 1.0},
+        {"etype": "step", "ts": 4.0, "step": 3, "step_time_s": 1.0},
+        {"etype": "recovery", "ts": 5.0, "action": "rollback", "step": 3,
+         "to_step": 2, "reason": "nan", "tier": "hot",
+         "t_detect": 4.0, "t_restored": 5.0},
+        # The runtime emits the recompile record BEFORE its owning step
+        # event (on_step_end order) — the fixture mirrors that.
+        {"etype": "recompile", "ts": 5.6, "step": 3, "compile_s": 0.5},
+        {"etype": "step", "ts": 6.1, "step": 3, "step_time_s": 1.1,
+         "compile_s": 0.5},
+        {"etype": "step", "ts": 7.1, "step": 4, "step_time_s": 1.0},
+        {"etype": "span", "ph": "X", "name": "checkpoint", "t0": 7.1,
+         "dur_s": 0.4, "tid": "train"},
+        {"etype": "aux_compile", "ts": 7.9, "what": "rollback",
+         "compile_s": 0.4},
+    ]
+
+
+def test_train_fixture_exact_seconds_and_bill():
+    led = GoodputLedger({0: _train_fixture_events()})
+    host = led.hosts[0]
+    assert host.kind == "train"
+    sec = host.seconds()
+    assert sec["productive_train"] == pytest.approx(0.8 + 1.0 + 0.6 + 1.0)
+    assert sec["data_wait"] == pytest.approx(0.2)
+    assert sec["compile"] == pytest.approx(1.0 + 0.5 + 0.4)
+    assert sec["snapshot_commit"] == pytest.approx(0.4)
+    # Discarded first execution (1.0) + detect->restore gap (1.0).
+    assert sec["rollback_replay"] == pytest.approx(2.0)
+    assert "unattributed" not in sec  # gap-free fixture: fully attributed
+    rec = host.reconcile()
+    assert rec["fraction"] == pytest.approx(1.0, abs=1e-6)
+    assert host.wall_s == pytest.approx(7.9)
+    assert host.goodput_pct == pytest.approx(100 * 3.4 / 7.9, abs=0.01)
+
+    # The incident bill: detection + restore + replay + recompile.
+    (inc,) = [i for i in led.incidents if i.kind == "rollback"]
+    assert inc.restore_s == pytest.approx(1.0)
+    assert inc.replay_s == pytest.approx(1.0)        # the discarded step
+    # Replay-window recompile (0.5) + the aux_compile drain (0.4).
+    assert inc.recompile_s == pytest.approx(0.9)
+    assert inc.wall_s == pytest.approx(2.9)
+    assert inc.t_detect == 4.0 and inc.t_restored == 5.0
+    assert inc.tokens_badput == 32                   # one discarded step
+
+
+def test_train_fixture_effective_tokens_no_double_billing():
+    led = GoodputLedger({0: _train_fixture_events()})
+    # Steps {1, 2, 3, 4} survive into final state; step 3 ran TWICE but
+    # the surviving set counts it once — double billing impossible.
+    assert led.tokens_per_step == 32
+    assert led.effective_train_tokens == 4 * 32
+    assert led.badput_train_tokens == 1 * 32
+    s = led.summary()
+    assert s["tokens"]["effective_train_tokens"] == 128
+    assert s["tokens"]["badput_train_tokens"] == 32
+    assert s["fleet"]["wall_s"] == pytest.approx(7.9)
+
+
+def test_train_tokens_counted_once_across_hosts():
+    """Two hosts emitting the same global steps must not double the
+    fleet's effective tokens — only the lead train shard counts."""
+    ev = _train_fixture_events()
+    led = GoodputLedger({0: ev, 1: [dict(e) for e in ev]})
+    assert len(led.hosts) == 2
+    assert led.effective_train_tokens == 4 * 32  # not 8 * 32
+
+
+# ---------------------------------------------------------------------------
+# hand-built serve fixture: evict + failover re-prefills, exact arithmetic
+# ---------------------------------------------------------------------------
+
+def _serve_fixture_events():
+    """3.5 s of scheduler timeline:
+
+    [0.0, 0.5]  prefill r1 (first — productive)
+    [0.5, 1.0]  decode
+    [1.0, 1.2]  idle gap (post-evict)
+    [1.2, 1.8]  re-prefill r1 after the evict -> failover_replay
+    [1.8, 2.5]  decode
+    [2.5, 2.6]  idle gap (failover window)
+    [2.6, 3.0]  re-prefill r2 after the cross-replica failover
+    [3.0, 3.5]  decode
+    """
+    return [
+        {"etype": "span", "ph": "X", "name": "req.prefill", "t0": 0.0,
+         "dur_s": 0.5, "rid": "r1", "tid": "r1"},
+        {"etype": "span", "ph": "X", "name": "decode_step", "t0": 0.5,
+         "dur_s": 0.5, "tid": "sched"},
+        {"etype": "serve_evict", "ts": 1.0, "rid": "r1",
+         "reason": "preempted", "iteration": 3, "generated": 3},
+        {"etype": "span", "ph": "X", "name": "req.prefill", "t0": 1.2,
+         "dur_s": 0.6, "rid": "r1", "tid": "r1"},
+        {"etype": "span", "ph": "X", "name": "decode_step", "t0": 1.8,
+         "dur_s": 0.7, "tid": "sched"},
+        {"etype": "router_failover", "ts": 2.5, "rid": "r2", "src": 0,
+         "dst": 1, "tokens_carried": 2, "hop": 1,
+         "t_detect": 2.5, "t_restored": 2.6},
+        {"etype": "span", "ph": "X", "name": "req.prefill", "t0": 2.6,
+         "dur_s": 0.4, "rid": "r2", "tid": "r2"},
+        {"etype": "span", "ph": "X", "name": "decode_step", "t0": 3.0,
+         "dur_s": 0.5, "tid": "sched"},
+        {"etype": "serve_request", "ts": 3.5, "rid": "r1", "state": "done",
+         "n_tokens": 6},
+        {"etype": "serve_request", "ts": 3.6, "rid": "r2", "state": "done",
+         "n_tokens": 4},
+        # The router emits its own terminal for the same rid — the token
+        # ledger dedupes by rid, so this must NOT double r2's tokens.
+        {"etype": "serve_request", "ts": 3.7, "rid": "r2", "state": "done",
+         "n_tokens": 4},
+    ]
+
+
+def test_serve_fixture_exact_seconds_tokens_bills():
+    led = GoodputLedger({1: _serve_fixture_events()})
+    host = led.hosts[1]
+    assert host.kind == "serve"
+    sec = host.seconds()
+    assert sec["prefill"] == pytest.approx(0.5)       # first prefill only
+    assert sec["productive_decode"] == pytest.approx(0.5 + 0.7 + 0.5)
+    # BOTH recomputes: the evict re-prefill and the failover re-prefill.
+    assert sec["failover_replay"] == pytest.approx(0.6 + 0.4)
+    assert sec["shed_or_idle"] == pytest.approx(0.2 + 0.1)
+    assert "unattributed" not in sec
+    assert host.reconcile()["fraction"] == pytest.approx(1.0, abs=1e-6)
+
+    evict = next(i for i in led.incidents if i.kind == "evict")
+    assert evict.rid == "r1" and evict.reason == "preempted"
+    assert evict.replay_s == pytest.approx(0.6)
+    assert evict.tokens_badput == 3                  # generated then thrown
+    fo = next(i for i in led.incidents if i.kind == "failover")
+    assert fo.rid == "r2"
+    assert fo.restore_s == pytest.approx(0.1)        # t_detect -> t_restored
+    assert fo.replay_s == pytest.approx(0.4)
+    assert fo.tokens_badput == 2                     # tokens re-decoded
+
+    # Token ledger: done-terminal tokens, deduped by rid.
+    assert led.effective_serve_tokens == 6 + 4
+    assert led.badput_serve_tokens == 3 + 2
+
+
+def test_serve_gap_during_breach_window_is_degraded():
+    """An idle gap while an SLO breach window is open classifies as
+    ``degraded`` with the objective as its cause, not ``shed_or_idle``."""
+    led = GoodputLedger({0: [
+        {"etype": "span", "ph": "X", "name": "decode_step", "t0": 0.0,
+         "dur_s": 1.0, "tid": "sched"},
+        {"etype": "slo_breach", "ts": 1.0, "objective": "ttft_p99_s"},
+        {"etype": "span", "ph": "X", "name": "decode_step", "t0": 2.0,
+         "dur_s": 0.5, "tid": "sched"},
+        {"etype": "slo_recovered", "ts": 2.5, "objective": "ttft_p99_s"},
+    ]})
+    sec = led.hosts[0].seconds()
+    assert sec["productive_decode"] == pytest.approx(1.5)
+    assert sec["degraded"] == pytest.approx(1.0)
+    deg = [iv for iv in led.hosts[0].intervals if iv.klass == "degraded"]
+    assert deg and deg[0].cause == "slo:ttft_p99_s"
+
+
+def test_every_interval_in_closed_taxonomy_and_badput_typed():
+    for events in (_train_fixture_events(), _serve_fixture_events()):
+        led = GoodputLedger({0: events})
+        for host in led.hosts.values():
+            for iv in host.intervals:
+                assert iv.klass in CLASSES
+                if iv.klass in TYPED_BADPUT or iv.klass == UNATTRIBUTED:
+                    assert iv.cause, iv
+
+
+def test_reducer_attaches_goodput_section(tmp_path):
+    """reduce_shards pools the ledger fleet-wide: a train shard and a
+    serve shard land in ONE ``goodput`` section."""
+    with open(shard_path(str(tmp_path), 0), "w") as f:
+        for e in _train_fixture_events():
+            f.write(json.dumps({"proc": 0, **e}) + "\n")
+    with open(shard_path(str(tmp_path), 1), "w") as f:
+        for e in _serve_fixture_events():
+            f.write(json.dumps({"proc": 1, **e}) + "\n")
+    red = reduce_shards(str(tmp_path))
+    gp = red["goodput"]
+    assert gp["hosts"]["0"]["kind"] == "train"
+    assert gp["hosts"]["1"]["kind"] == "serve"
+    assert gp["tokens"]["effective_train_tokens"] == 128
+    assert gp["tokens"]["effective_serve_tokens"] == 10
+    kinds = {i["kind"] for i in gp["incidents"]}
+    assert {"rollback", "evict", "failover"} <= kinds
+    assert gp["badput_waterfall"][0]["seconds"] > 0
+
+
+# ---------------------------------------------------------------------------
+# satellite 1: Histogram.merge
+# ---------------------------------------------------------------------------
+
+def test_histogram_merge_equals_single_on_concatenated_data():
+    rng = np.random.RandomState(7)
+    a = rng.lognormal(mean=-2.0, sigma=1.0, size=300).tolist()
+    b = rng.lognormal(mean=-1.0, sigma=0.5, size=200).tolist()
+    ha, hb, single = Histogram("x"), Histogram("x"), Histogram("x")
+    for v in a:
+        ha.observe(v)
+        single.observe(v)
+    for v in b:
+        hb.observe(v)
+        single.observe(v)
+    merged = ha.merge(hb)
+    assert merged is ha
+    assert merged.count == single.count == 500
+    assert merged.total == pytest.approx(single.total)
+    assert merged.min == single.min and merged.max == single.max
+    # Same fixed bucket layout on both sides -> merged percentiles equal
+    # the single-histogram percentiles EXACTLY, not just within a bucket.
+    for q in (0.01, 0.25, 0.50, 0.90, 0.99):
+        assert merged.percentile(q) == single.percentile(q), q
+
+
+def test_histogram_merge_empty_and_zero_bucket():
+    h = Histogram("x")
+    h.observe(0.0)
+    other = Histogram("x")
+    h.merge(other)                 # merging an empty histogram: no-op
+    assert h.count == 1 and h.percentile(0.5) == 0.0
+    other.observe(0.0)
+    other.observe(5.0)
+    h.merge(other)
+    assert h.count == 3 and h.max == 5.0
+
+
+# ---------------------------------------------------------------------------
+# Perfetto: counter track + aux_compile instant
+# ---------------------------------------------------------------------------
+
+def test_counter_events_render_as_perfetto_counter_track():
+    trace = to_chrome_trace([
+        {"etype": "span", "ph": "X", "name": "step", "t0": 0.0,
+         "dur_s": 1.0, "tid": "train", "proc": 0},
+        {"etype": "counter", "name": "goodput_pct", "value": 87.5,
+         "ts": 1.0, "proc": 0},
+        {"etype": "counter", "name": "goodput_pct", "value": 90.0,
+         "ts": 2.0, "proc": 0},
+    ])
+    counters = [e for e in trace["traceEvents"] if e["ph"] == "C"]
+    assert len(counters) == 2
+    for e in counters:
+        for k in ("ph", "ts", "dur", "pid", "tid", "name", "args"):
+            assert k in e, e
+        assert e["name"] == "goodput_pct"
+    assert counters[0]["args"] == {"goodput_pct": 87.5}
+    assert counters[1]["args"] == {"goodput_pct": 90.0}
+    ts = [e["ts"] for e in trace["traceEvents"] if e["ph"] != "M"]
+    assert ts == sorted(ts)
+
+
+def test_aux_compile_is_a_perfetto_instant():
+    trace = to_chrome_trace([
+        {"etype": "span", "ph": "X", "name": "step", "t0": 0.0,
+         "dur_s": 1.0, "tid": "train", "proc": 0},
+        {"etype": "aux_compile", "ts": 1.5, "what": "rollback",
+         "compile_s": 0.3, "proc": 0},
+    ])
+    marks = [e for e in trace["traceEvents"]
+             if e["ph"] == "i" and e["name"] == "aux_compile"]
+    assert marks and marks[0]["args"]["what"] == "rollback"
+
+
+# ---------------------------------------------------------------------------
+# SLO floor objective + online gauge
+# ---------------------------------------------------------------------------
+
+def test_slo_floor_breaches_below_and_recovers_above():
+    reg = MetricsRegistry()
+    sink = reg.add_sink(MemorySink())
+    mon = SloMonitor(
+        [Objective("goodput_min_pct", "goodput_pct", 90.0, "floor")],
+        reg, window=8, min_samples=2,
+    )
+    for v in (95.0, 94.0):
+        mon.observe("goodput_pct", v)
+    assert mon.evaluate(step=1) == []          # mean 94.5 >= 90: healthy
+    for v in (40.0, 30.0, 20.0, 10.0):
+        mon.observe("goodput_pct", v)
+    breaches = mon.evaluate(step=2)
+    assert breaches and breaches[0]["objective"] == "goodput_min_pct"
+    assert breaches[0]["value"] < 90.0
+    # A floor breach is NOT a latency breach: no degrade cap.
+    assert not mon.degrade_active
+    for v in (100.0,) * 8:                     # window refills healthy
+        mon.observe("goodput_pct", v)
+    assert mon.evaluate(step=3) == []
+    etypes = [e["etype"] for e in sink.events]
+    assert "slo_breach" in etypes and "slo_recovered" in etypes
+
+
+def test_slo_config_floor_objective_wired():
+    for runtime in ("train", "serve"):
+        mon = SloMonitor.from_config(
+            SloConfig(goodput_min_pct=75.0, min_samples=1, check_every=1),
+            None, runtime=runtime,
+        )
+        assert any(o.name == "goodput_min_pct" and o.kind == "floor"
+                   for o in mon.objectives), runtime
+
+
+def test_online_goodput_gauge_counter_cadence():
+    reg = MetricsRegistry()
+    sink = reg.add_sink(MemorySink())
+    gp = OnlineGoodput(reg, counter_every=2, window=16)
+    assert gp.update() is None                 # nothing noted yet
+    gp.note("productive_train", 3.0)
+    gp.note("compile", 1.0)
+    p = gp.update(step=1)
+    assert p == pytest.approx(75.0)
+    assert reg.gauge("goodput_pct").value == pytest.approx(75.0)
+    counters = [e for e in sink.events if e["etype"] == "counter"]
+    assert not counters                        # 1st update: below cadence
+    gp.note("shed_or_idle", 4.0)
+    p = gp.update(step=2)
+    assert p == pytest.approx(100 * 3.0 / 8.0)
+    counters = [e for e in sink.events if e["etype"] == "counter"]
+    assert len(counters) == 1
+    assert counters[0]["name"] == "goodput_pct"
+    assert counters[0]["value"] == pytest.approx(37.5)
+    gp.note("productive_decode", 0.0)          # zero-length: ignored
+    assert len(gp._win) == 3
+
+
+# ---------------------------------------------------------------------------
+# THE chaos acceptance run (ISSUE 16 acceptance criterion)
+# ---------------------------------------------------------------------------
+
+VOCAB = 61
+
+
+def _fleet_model():
+    cfg = ModelConfig(
+        vocab_size=VOCAB, d_model=32, n_layers=2, n_heads=2, d_ff=64,
+        max_seq_len=32, dropout=0.0, param_dtype="float32",
+        compute_dtype="float32", attention="dense",
+    )
+    from dtc_tpu.models.gpt import GPT
+
+    model = GPT(cfg)
+    params = model.init(
+        {"params": jax.random.PRNGKey(0)}, jnp.ones((1, 1), jnp.int32),
+        train=False,
+    )["params"]
+    return model, params
+
+
+def test_chaos_acceptance_nan_rollback_plus_replica_kill(
+    tiny_model_cfg, opt_cfg, tmp_path
+):
+    """One acceptance run over BOTH chaos paths: a chaos NaN at step 3
+    (rollback + replay through the real guard) and a fleet replica kill
+    mid-traffic (failover re-prefill through the real router). The
+    combined ledger must (a) reconcile per-host interval sums with
+    wall-clock within 1%, (b) keep ``unattributed`` under 5%, (c) type
+    every badput second, and (d) bill both incident kinds."""
+    from dtc_tpu.serve import FleetRouter, ReplicaState, Request
+    from dtc_tpu.train.trainer import train
+
+    # --- leg 1: real trainer, chaos NaN -> rollback ---
+    train_dir = str(tmp_path / "train")
+    train(
+        TrainConfig(
+            seed=0, parallel="dp", batch=8, steps=6, log_every=1,
+            output_dir=train_dir, dataset="synthetic", warmup_steps=1,
+            prefetch=0, mesh=MeshConfig(), checkpoint_every=2,
+            checkpoint_dir=str(tmp_path / "ckpt"),
+            resilience=ResilienceConfig(
+                chaos=ChaosConfig(enabled=True, nan_at_step=3),
+            ),
+        ),
+        tiny_model_cfg, opt_cfg,
+    )
+
+    # --- leg 2: real fleet, chaos replica kill mid-traffic ---
+    model, params = _fleet_model()
+    fleet_dir = str(tmp_path / "fleet")
+    router = FleetRouter(model, params, RouterConfig(
+        n_replicas=2,
+        retry=StreamRetryConfig(max_attempts=2, backoff_s=0.0,
+                                backoff_max_s=0.0, jitter=0.0),
+        serve=ServeConfig(slots=2, page_size=4, queue_depth=16,
+                          max_new_tokens=6, prefill_bucket=8),
+        chaos=ChaosConfig(enabled=True, fleet_kill_replica_at_step=3,
+                          fleet_target_replica=0),
+    ), obs_dir=fleet_dir)
+    rng = np.random.RandomState(3)
+    for i in range(6):
+        router.submit(Request(
+            rid=f"r{i}", prompt=rng.randint(0, VOCAB, 4 + i % 3).tolist(),
+            max_new_tokens=6,
+        ))
+    router.run(max_steps=300)
+    router.close()
+    assert router.replicas[0].state is ReplicaState.DEAD
+
+    # --- the combined ledger: one run's train + fleet shards ---
+    import glob as _glob
+    import re as _re
+
+    from dtc_tpu.obs.registry import read_jsonl
+
+    by_proc = {}
+    for led_dir, base in ((os.path.join(train_dir, "obs"), 0),
+                          (fleet_dir, 100)):
+        for path in _glob.glob(os.path.join(led_dir, "events.r*.jsonl")):
+            k = int(_re.search(r"events\.r(\d+)\.jsonl$", path).group(1))
+            by_proc[base + k] = read_jsonl(path)
+    led = GoodputLedger(by_proc)
+
+    kinds = {i.kind for i in led.incidents}
+    assert "rollback" in kinds, kinds
+    assert "failover" in kinds, kinds
+    rb = next(i for i in led.incidents if i.kind == "rollback")
+    assert rb.t_detect is not None and rb.t_restored is not None
+    assert rb.wall_s > 0 and rb.tokens_badput > 0
+    # At least one failover re-prefill was matched and billed.
+    assert any(i.kind == "failover" and i.replay_s > 0
+               for i in led.incidents), [i.to_dict() for i in led.incidents]
+
+    assert led.hosts, "acceptance run produced no classifiable shards"
+    host_kinds = {h.kind for h in led.hosts.values()}
+    assert host_kinds == {"train", "serve"}
+    for proc, host in led.hosts.items():
+        rec = host.reconcile()
+        assert rec["fraction"] >= 0.99, (proc, rec)      # (a) <= 1% drift
+        assert host.unattributed_pct <= 5.0, (proc, host.summary())  # (b)
+        for iv in host.intervals:                        # (c) typed causes
+            assert iv.klass in CLASSES
+            if iv.klass not in PRODUCTIVE:
+                assert iv.cause, (proc, iv)
+
+    s = led.summary()
+    assert s["tokens"]["effective_train_tokens"] > 0
+    assert s["tokens"]["effective_serve_tokens"] > 0
+    assert s["fleet"]["goodput_pct"] is not None

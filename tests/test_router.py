@@ -728,9 +728,12 @@ def test_reducer_fleet_percentiles(tmp_path):
     ])
     red = reduce_shards(str(tmp_path))
     assert red["serve"]["requests"] == 3
-    assert red["serve"]["ttft_p50_s"] == 0.2      # pooled nearest-rank
-    assert red["serve"]["ttft_p99_s"] == 0.3
-    assert red["serve"]["ms_per_token_p99"] == 30.0
+    # Pooled percentiles come from merged histograms (ISSUE 16): exact
+    # to within one log-bucket (growth 1.1), so assert rel=0.1 — the
+    # per-host values below stay exact nearest-rank.
+    assert red["serve"]["ttft_p50_s"] == pytest.approx(0.2, rel=0.1)
+    assert red["serve"]["ttft_p99_s"] == pytest.approx(0.3, rel=0.1)
+    assert red["serve"]["ms_per_token_p99"] == pytest.approx(30.0, rel=0.1)
     assert red["serve"]["failover_hops"] == 1
     assert red["serve"]["tokens_per_sec"] == 8.0  # 16 tokens / 2 s span
     assert red["hosts"]["0"]["ttft_p99_s"] == 0.3
