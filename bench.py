@@ -593,6 +593,7 @@ def serve_bench(
     from dtc_tpu.config.schema import ServeConfig
     from dtc_tpu.models.gpt import GPT
     from dtc_tpu.serve import QueueFullError, Request, RequestState, ServingEngine
+    from dtc_tpu.utils.arrivals import arrival_schedule
 
     model_cfg = model_cfg or flagship_model_cfg(dropout=0.0)
     if n_tenants > 0:
@@ -630,16 +631,9 @@ def serve_bench(
             eng.load_adapter(f"tenant{t}", factors)
             tenant_names.append(f"tenant{t}")
 
-    rng = np.random.RandomState(seed)
-    arrivals = (
-        np.zeros(n_requests)
-        if rps is None
-        else np.cumsum(rng.exponential(1.0 / rps, size=n_requests))
+    arrivals, prompts = arrival_schedule(
+        seed, n_requests, prompt_len, model_cfg.vocab_size, rps,
     )
-    prompts = [
-        rng.randint(0, model_cfg.vocab_size, size=prompt_len).tolist()
-        for _ in range(n_requests)
-    ]
     # Warm the compiled surfaces outside the measured window (one
     # admission + one decode step), so row 1 doesn't pay the jit tax —
     # then drop the warm request's samples from the SLO histograms so
@@ -842,6 +836,7 @@ def fleet_bench(
     from dtc_tpu.config.schema import ChaosConfig, RouterConfig, ServeConfig
     from dtc_tpu.models.gpt import GPT
     from dtc_tpu.serve import FleetRouter, QueueFullError, Request, RequestState
+    from dtc_tpu.utils.arrivals import arrival_schedule
 
     model_cfg = model_cfg or flagship_model_cfg(dropout=0.0)
     model = GPT(model_cfg)
@@ -866,16 +861,9 @@ def fleet_bench(
         ),
     )
     router = FleetRouter(model, params, rcfg, obs_dir=obs_dir or "")
-    rng = np.random.RandomState(seed)
-    arrivals = (
-        np.zeros(n_requests)
-        if rps is None
-        else np.cumsum(rng.exponential(1.0 / rps, size=n_requests))
+    arrivals, prompts = arrival_schedule(
+        seed, n_requests, prompt_len, model_cfg.vocab_size, rps,
     )
-    prompts = [
-        rng.randint(0, model_cfg.vocab_size, size=prompt_len).tolist()
-        for _ in range(n_requests)
-    ]
     router.warmup(prompts[0])
 
     rejected = 0
@@ -1031,6 +1019,55 @@ def goodput_row_from_obs(obs_dir: str, base_row: dict) -> dict:
     }
 
 
+def pool_bench(chaos: bool = True) -> dict:
+    """Resource-pool row (ISSUE 17): one scripts/pool_smoke.py leg in a
+    subprocess — the pool needs the 8-virtual-device mesh, which the
+    bench process (single device) cannot host. The smoke's own gates
+    (typed transitions, zero silent drops, loss parity, exactly one
+    recompile per mesh change, goodput billing) all hold or the row is
+    an error; the row itself is the machine-readable '# pool-smoke:'
+    summary (train tokens/s under arbitration, fleet completions,
+    transition/resize/recompile counts, goodput %)."""
+    import os
+    import re
+    import subprocess
+    import sys
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (
+        "--xla_force_host_platform_device_count=8 "
+        "--xla_cpu_use_thunk_runtime=false"
+    )
+    cmd = [sys.executable, "scripts/pool_smoke.py", "--json"]
+    if chaos:
+        cmd.append("--chaos")
+    proc = subprocess.run(
+        cmd, capture_output=True, text=True, timeout=600, env=env,
+        cwd=os.path.dirname(os.path.abspath(__file__)),
+    )
+    if proc.returncode != 0:
+        return {
+            "error": f"pool_smoke rc={proc.returncode}",
+            "tail": (proc.stdout + proc.stderr)[-400:],
+        }
+    m = re.search(r"# pool-smoke: (\{.*\})", proc.stdout)
+    if not m:
+        return {"error": "pool_smoke printed no '# pool-smoke:' row"}
+    return json.loads(m.group(1))
+
+
+def pool_diurnal_rows(emit) -> None:
+    """The pool row family: the clean diurnal leg and the combined-chaos
+    leg (spike-mid-grow abort + kill-mid-shrink) side by side — the
+    delta in train tokens/s is the measured price of surviving chaos
+    under arbitration."""
+    emit("pool_diurnal", _safe(
+        "pool_diurnal", lambda: pool_bench(chaos=False)))
+    emit("pool_diurnal_chaos", _safe(
+        "pool_diurnal_chaos", lambda: pool_bench(chaos=True)))
+
+
 def _bench_detail(path: str) -> dict:
     """Parsed ``# bench-detail:`` dict of one committed BENCH file, or {}.
 
@@ -1178,6 +1215,13 @@ def decode_drift_guard(extra: dict, repo_dir: str | None = None) -> list[str]:
         o.get(k) == r.get(k) for k in (
             "platform", "serve_model", "n_replicas", "kill_replica_at")
     ), higher_is_better=True)
+    # Pool rows (ISSUE 17): train tokens/s under arbitration is
+    # higher-is-better. Same-config rule: platform + model + chaos leg
+    # must match — the clean diurnal leg is never judged against the
+    # combined-chaos one.
+    compare("pool", "train_tokens_per_sec", lambda o, r: all(
+        o.get(k) == r.get(k) for k in ("platform", "serve_model", "chaos")
+    ), higher_is_better=True)
 
     if flags:
         extra["decode_regressions"] = flags
@@ -1289,6 +1333,13 @@ def main(argv: list[str] | None = None) -> None:
         "(ISSUE 16 — effective-tokens/s next to raw tokens/s)",
     )
     ap.add_argument(
+        "--pool-only", action="store_true",
+        help="run ONLY the resource-pool rows (ISSUE 17 — the diurnal "
+        "and combined-chaos pool_smoke legs in subprocesses; train "
+        "tokens/s under arbitration next to fleet completions and the "
+        "transition/recompile counts)",
+    )
+    ap.add_argument(
         "--devprof-only", action="store_true",
         help="run ONLY the device-time attribution row + trace overhead "
         "(ISSUE 8 — the CPU-measured observatory artifact path while the "
@@ -1338,6 +1389,25 @@ def main(argv: list[str] | None = None) -> None:
                 k: v for k, v in ev.items()
                 if k not in ("etype", "ts", "proc", "label")
             }
+        print("# bench-detail:", json.dumps(extra))
+        reg.close()
+        return
+
+    if args.pool_only:
+        pool_diurnal_rows(emit)
+        extra = {
+            "devices": jax.device_count(),
+            "device_kind": jax.devices()[0].device_kind,
+        }
+        for ev in sink.events:
+            if ev["etype"] != "bench_config":
+                continue
+            extra[ev["label"]] = {
+                k: v for k, v in ev.items()
+                if k not in ("etype", "ts", "proc", "label")
+            }
+        for flag in decode_drift_guard(extra):
+            print(f"# DECODE REGRESSION: {flag}")
         print("# bench-detail:", json.dumps(extra))
         reg.close()
         return
@@ -1487,6 +1557,11 @@ def main(argv: list[str] | None = None) -> None:
     # replicas — calibration, 0.9x/3x offered load, and the replica-kill
     # chaos leg (failover mid-traffic, zero silent drops).
     serve_fleet_rows(emit, seed=args.serve_seed, **serve_cfg_kw)
+    # Resource-pool rows (ISSUE 17): the diurnal and combined-chaos
+    # pool_smoke legs, each in a subprocess with its own 8-virtual-device
+    # mesh — train tokens/s under arbitration next to fleet completions
+    # and the transition/recompile counts.
+    pool_diurnal_rows(emit)
     # Tracing substrate cost (ISSUE 7): host-side span-emission µs per
     # step, A/B traced vs untraced — PERF.md reads the % off this row.
     emit("trace_overhead", _safe("trace_overhead", trace_overhead_bench))

@@ -2,12 +2,14 @@ from dtc_tpu.config.schema import (
     MeshConfig,
     ModelConfig,
     OptimConfig,
+    PoolConfig,
     RouterConfig,
     ServeConfig,
     TrainConfig,
 )
 from dtc_tpu.config.loader import (
     load_config,
+    load_pool_config,
     load_router_config,
     load_serve_config,
     load_yaml_dataclass,
@@ -17,10 +19,12 @@ __all__ = [
     "MeshConfig",
     "ModelConfig",
     "OptimConfig",
+    "PoolConfig",
     "RouterConfig",
     "ServeConfig",
     "TrainConfig",
     "load_config",
+    "load_pool_config",
     "load_router_config",
     "load_serve_config",
     "load_yaml_dataclass",

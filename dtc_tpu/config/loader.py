@@ -116,6 +116,35 @@ def load_router_config(
     return router_cfg, model_cfg
 
 
+def load_pool_config(
+    pool_config_path: str | Path,
+    model_config_path: str | Path | None = None,
+    pool_overrides: dict[str, Any] | None = None,
+    model_overrides: dict[str, Any] | None = None,
+):
+    """Load the (pool, model) config pair for the resource pool
+    (``dtc_tpu/pool/``).
+
+    Same sibling-``model_config.yaml`` convention as
+    :func:`load_serve_config`; the fleet front-end nests under the pool
+    YAML's ``router:`` block and the per-replica engine config under
+    ``router.serve:`` (see ``configs/pool_config.yaml``).
+    """
+    from dtc_tpu.config.schema import ModelConfig, PoolConfig
+
+    pool_config_path = Path(pool_config_path)
+    model_config_path = Path(
+        model_config_path or pool_config_path.parent / "model_config.yaml"
+    )
+    pool_cfg = load_yaml_dataclass(
+        pool_config_path, PoolConfig, overrides=pool_overrides
+    )
+    model_cfg = load_yaml_dataclass(
+        model_config_path, ModelConfig, overrides=model_overrides
+    )
+    return pool_cfg, model_cfg
+
+
 def load_finetune_config(
     finetune_config_path: str | Path,
     model_config_path: str | Path | None = None,

@@ -644,6 +644,19 @@ class ChaosConfig:
     lose_snapshot_at_step: int = 0    # drop the victim's primary snapshot copy
     torn_cold_spill_at_step: int = 0  # truncate the cold checkpoint written at step N
     elastic_target_host: int = 0      # victim virtual host for elastic faults
+    # --- pool faults (dtc_tpu/pool/, ISSUE 17; tick numbers are 1-based
+    # POOL ticks, consulted only while the named transition is actually
+    # in flight — deferred-fire, so the shot lands on the transition, not
+    # on steady state). Spike-mid-grow drives clean grow abort/rollback
+    # (or complete-then-shrink) with zero silent request drops;
+    # kill-mid-shrink kills the SURRENDERING host (its snapshot primaries
+    # die with it) so the restore must come from the ring mirror;
+    # kill-draining-replica kills the replica being retired mid-drain so
+    # its in-flight requests must fail over token-identically.
+    pool_spike_mid_grow_at: int = 0       # request burst while a GROW is in flight
+    pool_spike_requests: int = 8          # burst size for pool_spike_mid_grow
+    pool_kill_mid_shrink_at: int = 0      # elastic_target_host dies mid-surrender
+    pool_kill_draining_replica_at: int = 0  # kill the retiring replica mid-drain
 
     def __post_init__(self) -> None:
         if self.corrupt_mode not in ("truncate", "flip"):
@@ -658,6 +671,8 @@ class ChaosConfig:
             raise ValueError("slow_host_iters must be >= 1")
         if self.elastic_target_host < 0:
             raise ValueError("elastic_target_host must be >= 0")
+        if self.pool_spike_requests < 1:
+            raise ValueError("pool_spike_requests must be >= 1")
 
 
 @dataclass(frozen=True)
@@ -996,13 +1011,116 @@ class RouterConfig:
             raise ValueError("failover_max_hops must be >= 0")
         if self.drain_max_steps < 1:
             raise ValueError("drain_max_steps must be >= 1")
-        if (
-            self.chaos.enabled
-            and self.chaos.fleet_target_replica >= self.n_replicas
+        # NOTE (ISSUE 17): fleet_target_replica vs the live replica set is
+        # deliberately NOT validated here. With spawn/retire the replica
+        # set is dynamic, so a construction-time bound against n_replicas
+        # is both too strict (a replica spawned later is a legal target)
+        # and too weak (a replica retired later silently no-ops the
+        # drill). The router judges the target when the fault FIRES and
+        # raises a typed ChaosTargetError on a stale/unknown victim.
+
+
+@dataclass(frozen=True)
+class PoolConfig:
+    """Resource-pool configuration (``dtc_tpu/pool/``, ISSUE 17): one
+    fixed virtual-device pool arbitrated between the serving fleet and
+    the elastic trainer. Each virtual host is leased to exactly one
+    tenant at a time — a serving host runs one engine replica, a
+    training host contributes its devices to the train mesh. GROW moves
+    a host serve→train (retire-drain the replica, admit the host,
+    resize the mesh up, restore the newest complete snapshot); SHRINK
+    moves it train→serve (ensure a complete snapshot, retire the host
+    from the monitor, resize down, spawn a replica — zero compiles via
+    the engine fn cache). See README "Resource pool / autoscaling" and
+    ``configs/pool_config.yaml`` for knob semantics.
+    """
+
+    # Virtual hosts the pool's devices split into (contiguous groups;
+    # must divide the device count — 8 emulated CPU devices / 4 hosts =
+    # 2 devices per host).
+    n_hosts: int = 4
+    # Hosts initially leased to the TRAINER (the rest each run one
+    # serving replica).
+    train_hosts: int = 2
+    # Floor on each tenant's lease: the pool never grows/shrinks past
+    # these (serving always keeps >= min_serve_hosts replicas up, the
+    # trainer never drops below min_train_hosts).
+    min_serve_hosts: int = 1
+    min_train_hosts: int = 1
+    # Train-mesh model (TP) axis; the data axis absorbs resizes. Every
+    # legal lease size must be divisible by it.
+    model_axis: int = 1
+    # GLOBAL train batch — preserved across every resize (the per-device
+    # batch rescales), so the loss trajectory stays comparable.
+    global_batch: int = 8
+    # Training budget (steps) the pool must complete despite arbitration.
+    train_steps: int = 12
+    # Hot-tier snapshot cadence / retention for the train tenant.
+    snapshot_every: int = 1
+    snapshot_keep: int = 4
+    # Consecutive missed heartbeats before the train tenant's monitor
+    # declares a host lost.
+    heartbeat_miss_limit: int = 2
+    # Consecutive ticks with an empty fleet queue (and no in-flight
+    # traffic beyond the floor's capacity) before the pool requests a
+    # trainer GROW from an idle serving host.
+    grow_after_idle_ticks: int = 2
+    # Pending requests per accepting replica above which the pool
+    # reclaims capacity for serving (trainer SHRINK -> spawn replica).
+    spike_queue_depth: int = 3
+    # Fleet front-end (placement, health, failover) for the serving
+    # tenant; the pool derives the live replica count from its host
+    # leases, so router.n_replicas is overridden at construction.
+    router: RouterConfig = field(default_factory=RouterConfig)
+    # Pool-level chaos (pool_spike_mid_grow / pool_kill_mid_shrink /
+    # pool_kill_draining_replica — see ChaosConfig).
+    chaos: ChaosConfig = field(default_factory=ChaosConfig)
+
+    def __post_init__(self) -> None:
+        if self.n_hosts < 2:
+            raise ValueError("pool.n_hosts must be >= 2")
+        # min_serve_hosts=0 is legal: the diurnal full-grow leases EVERY
+        # host to the trainer and the pool PARKS arriving requests (typed
+        # backpressure, re-submitted when capacity returns) — never
+        # drops them.
+        if self.min_serve_hosts < 0 or self.min_train_hosts < 1:
+            raise ValueError(
+                "pool.min_serve_hosts must be >= 0 and "
+                "pool.min_train_hosts >= 1"
+            )
+        if not (
+            self.min_train_hosts
+            <= self.train_hosts
+            <= self.n_hosts - self.min_serve_hosts
         ):
             raise ValueError(
-                f"chaos.fleet_target_replica {self.chaos.fleet_target_replica} "
-                f"outside the fleet (n_replicas={self.n_replicas})"
+                f"pool.train_hosts {self.train_hosts} violates the lease "
+                f"floors (min_train_hosts={self.min_train_hosts}, "
+                f"min_serve_hosts={self.min_serve_hosts}, "
+                f"n_hosts={self.n_hosts})"
+            )
+        if self.model_axis < 1:
+            raise ValueError("pool.model_axis must be >= 1")
+        if self.global_batch < 1 or self.train_steps < 1:
+            raise ValueError("pool.global_batch/train_steps must be >= 1")
+        if self.snapshot_every < 1:
+            raise ValueError("pool.snapshot_every must be >= 1")
+        if self.snapshot_keep < 2:
+            raise ValueError("pool.snapshot_keep must be >= 2")
+        if self.heartbeat_miss_limit < 1:
+            raise ValueError("pool.heartbeat_miss_limit must be >= 1")
+        if self.grow_after_idle_ticks < 1:
+            raise ValueError("pool.grow_after_idle_ticks must be >= 1")
+        if self.spike_queue_depth < 1:
+            raise ValueError("pool.spike_queue_depth must be >= 1")
+        if (
+            self.chaos.enabled
+            and self.chaos.pool_kill_mid_shrink_at > 0
+            and self.chaos.elastic_target_host >= self.n_hosts
+        ):
+            raise ValueError(
+                f"chaos.elastic_target_host {self.chaos.elastic_target_host} "
+                f"outside the pool (n_hosts={self.n_hosts})"
             )
 
 

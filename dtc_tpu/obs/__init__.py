@@ -33,6 +33,8 @@ from dtc_tpu.obs.devprof import (
 from dtc_tpu.obs.profiling import StepWindowProfiler
 from dtc_tpu.obs.registry import (
     CsvSink,
+    Histogram,
+    HistogramLayoutError,
     JsonlSink,
     MemorySink,
     MetricsRegistry,
@@ -56,6 +58,8 @@ __all__ = [
     "CsvSink",
     "DeviceProfiler",
     "FlightRecorder",
+    "Histogram",
+    "HistogramLayoutError",
     "JsonlSink",
     "MemorySink",
     "MetricsRegistry",

@@ -222,7 +222,10 @@ class HostLedger:
 #: SKIPPED — the ``step``/``compile`` events carry the same seconds and
 #: exist even with tracing off; consuming both would double-count.
 _SERVE_SPANS = ("decode_step", "req.prefill")
-_COMMIT_SPANS = ("checkpoint", "elastic_spill")
+#: ``snapshot_dispatch`` (PR 17) is the synchronous half of an async
+#: in-memory snapshot: device copies dispatched on the hot loop before
+#: the commit thread takes over — snapshot wall, same class.
+_COMMIT_SPANS = ("checkpoint", "elastic_spill", "snapshot_dispatch")
 
 _SERVE_MARKERS = frozenset({
     "serve_request", "serve_admit", "serve_evict", "serve_reject",
@@ -458,6 +461,16 @@ class GoodputLedger:
                 elif name in _COMMIT_SPANS:
                     raw.append(Interval(
                         t0, t0 + d, SNAPSHOT_COMMIT, cause=name,
+                    ))
+                elif name == "pool.timeshare":
+                    # Pool co-tenancy (PR 17): the train tenant yielded
+                    # its CPU slice to the serving fleet for this window
+                    # (one process time-slices every pool "host"). A
+                    # typed yield, not an unattributed hole — but NOT
+                    # ``serveish``: the shard is still a trainer and its
+                    # other gaps must stay unattributed.
+                    raw.append(Interval(
+                        t0, t0 + d, SHED_OR_IDLE, cause="timeshare",
                     ))
             elif et == "slo_breach":
                 obj = str(e.get("objective", "slo"))

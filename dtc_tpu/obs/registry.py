@@ -51,11 +51,22 @@ class Gauge:
         self.value = v if v is None else float(v)
 
 
-#: Log-bucket growth factor for Histogram quantiles: each bucket spans
-#: ~10% relative width, so any reported pNN is within one 10% bucket of
-#: the exact nearest-rank value (the parity tests pin this bound).
+#: Default log-bucket growth factor for Histogram quantiles: each bucket
+#: spans ~10% relative width, so any reported pNN is within one 10%
+#: bucket of the exact nearest-rank value (the parity tests pin this
+#: bound).
 HIST_BUCKET_GROWTH = 1.1
 _LOG_GROWTH = math.log(HIST_BUCKET_GROWTH)
+
+
+class HistogramLayoutError(ValueError):
+    """Two histograms with different bucket layouts were merged.
+
+    Bucket indices are only comparable under the SAME growth factor — a
+    cross-layout merge would sum counts of buckets covering different
+    value ranges and silently corrupt every percentile downstream (the
+    cross-shard reducer pools dozens of per-replica histograms; one
+    mismatched shard must fail loudly, not skew the fleet's p99)."""
 
 
 class Histogram:
@@ -72,8 +83,15 @@ class Histogram:
     byte-compatible and adds ``p50/p90/p99``.
     """
 
-    def __init__(self, name: str):
+    def __init__(self, name: str, *, bucket_growth: float = HIST_BUCKET_GROWTH):
+        if bucket_growth <= 1.0:
+            raise ValueError(
+                f"histogram {name}: bucket_growth must be > 1.0 "
+                f"(got {bucket_growth})"
+            )
         self.name = name
+        self.bucket_growth = float(bucket_growth)
+        self._log_growth = math.log(self.bucket_growth)
         self.reset()
 
     def reset(self) -> None:
@@ -95,7 +113,7 @@ class Histogram:
         self.total += v
         self.min = v if self.min is None else min(self.min, v)
         self.max = v if self.max is None else max(self.max, v)
-        idx = None if v <= 0.0 else math.floor(math.log(v) / _LOG_GROWTH)
+        idx = None if v <= 0.0 else math.floor(math.log(v) / self._log_growth)
         self._buckets[idx] = self._buckets.get(idx, 0) + 1
 
     @property
@@ -119,19 +137,29 @@ class Histogram:
             if seen >= rank:
                 if idx is None:
                     return max(0.0, self.min if self.min is not None else 0.0)
-                mid = math.exp((idx + 0.5) * _LOG_GROWTH)
+                mid = math.exp((idx + 0.5) * self._log_growth)
                 return min(max(mid, self.min), self.max)
         return self.max  # unreachable: counts always cover rank
 
     def merge(self, other: "Histogram") -> "Histogram":
         """Fold ``other``'s observations into this histogram, in place.
 
-        Bucket counts sum (both sides use the same fixed log-bucket
-        layout, so a merged histogram's ``percentile`` equals a single
-        histogram fed the concatenated samples — exactly, not within a
-        bucket; the unit tests pin this). This is how the cross-shard
-        reducer pools per-replica latency distributions without
-        re-deriving them from raw ``serve_request`` samples."""
+        Bucket counts sum — legal ONLY when both sides share the same
+        log-bucket layout (a merged histogram's ``percentile`` then
+        equals a single histogram fed the concatenated samples —
+        exactly, not within a bucket; the unit tests pin this, along
+        with merge-order invariance). A layout mismatch raises
+        :class:`HistogramLayoutError` instead of silently summing
+        incomparable bucket indices. This is how the cross-shard reducer
+        pools per-replica latency distributions without re-deriving them
+        from raw ``serve_request`` samples."""
+        if other.bucket_growth != self.bucket_growth:
+            raise HistogramLayoutError(
+                f"cannot merge histogram {other.name!r} "
+                f"(bucket_growth={other.bucket_growth}) into "
+                f"{self.name!r} (bucket_growth={self.bucket_growth}): "
+                "bucket indices are not comparable across layouts"
+            )
         self.count += other.count
         self.total += other.total
         if other.min is not None:

@@ -247,6 +247,13 @@ _INSTANT_ETYPES = frozenset({
     # recompile cost an incident bill attributes — get a mark where they
     # happened instead of vanishing from the timeline.
     "aux_compile",
+    # Resource pool (ISSUE 17): every lease transition edge, spike,
+    # parked/unparked request, grow abort, and chaos host-kill gets an
+    # instant, so a merged trace shows the arbitration next to the
+    # tenant activity it displaced.
+    "pool_transition", "pool_grow_abort", "pool_spike",
+    "pool_request_parked", "pool_request_unparked", "pool_host_killed",
+    "pool_closed",
 })
 
 

@@ -1,0 +1,20 @@
+"""Resource pool: one chaos-verified manager over train + serve (ISSUE 17).
+
+See :mod:`dtc_tpu.pool.manager` for the PoolManager and the typed
+transition state machine, README "Resource pool / autoscaling" for
+semantics, and ``configs/pool_config.yaml`` for knobs.
+"""
+
+from dtc_tpu.pool.manager import (
+    POOL_ROUTER_PROC,
+    POOL_TRAIN_PROC,
+    PoolManager,
+    PoolTransition,
+)
+
+__all__ = [
+    "POOL_ROUTER_PROC",
+    "POOL_TRAIN_PROC",
+    "PoolManager",
+    "PoolTransition",
+]

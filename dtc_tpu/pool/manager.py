@@ -1,0 +1,903 @@
+"""PoolManager: one fixed virtual-device pool arbitrated between the
+serving fleet and the elastic trainer (ISSUE 17).
+
+The ROADMAP north-star is a production system where the same chip pool
+serves diurnal traffic AND keeps training — capacity must move between
+the tenants without dropping a request or losing a step. Every enabler
+landed earlier: PR 12's FleetRouter/EngineReplica seam (spawn/retire +
+failover), PR 14/15's ring-mirrored snapshots + shrink-and-continue
+(now generalized to :func:`~dtc_tpu.resilience.elastic.resize_mesh` —
+GROW is shrink in reverse), PR 16's goodput ledger to price every
+transition. This module is the arbiter on top.
+
+**Leases.** Each of the pool's ``n_hosts`` virtual hosts is leased to
+exactly one tenant at a time: a serving host runs one engine replica, a
+training host contributes its devices to the train mesh. The pool owns
+the lease table; the tenants own their machinery.
+
+**Transitions** are a typed state machine — every lease move walks
+
+    requested -> draining -> reassigned -> resized -> steady
+
+(one state per pool tick, so every stage is observable and chaos can
+land inside any of them; a GROW interrupted by a load spike before its
+mesh is rebuilt takes the one extra edge ``-> aborted`` and rolls back
+cleanly). For a GROW (serve -> train): ``draining`` retires the victim
+replicas (stop routing new work, in-flight finishes — or fails over if
+chaos kills the replica mid-drain), ``reassigned`` admits the freed
+hosts to the trainer's monitor roster, ``resized`` rebuilds the larger
+mesh and restores the newest complete snapshot onto it with fresh
+NamedShardings (per-device batch rescales, GLOBAL batch preserved, the
+row stream re-seeks by tokens consumed), ``steady`` lands after the
+first post-resize step — which pays the mesh change's exactly-one
+recompile. For a SHRINK (train -> serve): ``draining`` ensures a
+complete snapshot covers the current step, ``reassigned`` retires the
+surrendered hosts from the monitor (deliberate surrender, not death),
+``resized`` rebuilds the smaller mesh (a host chaos-killed
+mid-surrender is safe: its snapshot primaries died with it, the ring
+mirror sources the restore) and spawns replicas on the freed hosts —
+zero compiles, the engine fn cache shares the jitted executables.
+
+**Zero silent drops.** ``submit()`` parks requests the fleet cannot
+admit (including the zero-replica full-grow phase) in a pool-level
+pending queue and re-submits as capacity returns; close() reconciles
+every parked leftover to a typed FAILED terminal. Every rid therefore
+ends in a typed terminal somewhere — router, engine, or pool backstop.
+
+**Honesty.** Pool "hosts" time-slice one CPU process: wall-clocks are
+shape-only (a transition's measured seconds reflect this emulation, not
+DCN). What IS real: detection and recovery read only surviving state,
+GROW restores are bit-checked against a fresh restart from the same
+snapshot, and every recompile is counted, asserted, and billed.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Callable
+
+import numpy as np
+
+from dtc_tpu.obs.registry import JsonlSink, MetricsRegistry
+from dtc_tpu.resilience.chaos import ChaosInjector
+from dtc_tpu.resilience.elastic import HostMonitor, VirtualHosts, resize_mesh
+from dtc_tpu.resilience.errors import ElasticAbort
+from dtc_tpu.resilience.events import RecoveryBus
+from dtc_tpu.resilience.snapshot import SnapshotStore
+from dtc_tpu.serve.replica import ReplicaState
+from dtc_tpu.serve.request import (
+    FleetSaturatedError,
+    QueueFullError,
+    Request,
+    RequestFailedError,
+    RequestState,
+    ServeResult,
+)
+from dtc_tpu.serve.router import FleetRouter
+from dtc_tpu.utils.arrivals import seeded_prompts
+
+PyTree = Any
+
+#: Obs shard (process index) for the router's own registry under the
+#: pool — well above any replica id spawn/retire will ever mint.
+POOL_ROUTER_PROC = 64
+#: Obs shard for the train tenant's registry.
+POOL_TRAIN_PROC = 65
+
+#: The typed transition machine: every edge a lease move may take.
+#: Advancement is one edge per pool tick; anything else is a bug, not a
+#: new state — _advance raises on an illegal edge.
+_TRANSITION_EDGES: dict[str, frozenset[str]] = {
+    "requested": frozenset({"draining", "aborted"}),
+    "draining": frozenset({"reassigned", "aborted"}),
+    "reassigned": frozenset({"resized", "aborted"}),
+    "resized": frozenset({"steady"}),
+    "steady": frozenset(),
+    "aborted": frozenset(),
+}
+
+
+@dataclasses.dataclass
+class PoolTransition:
+    """One lease move through the typed state machine."""
+
+    kind: str                    # "grow" | "shrink"
+    hosts: list[int]             # hosts changing tenant
+    tick: int                    # pool tick the transition was requested
+    state: str = "requested"
+    replicas: list[int] = dataclasses.field(default_factory=list)
+    t_requested: float = 0.0
+    t_detect: float | None = None    # mesh-rebuild start (the stall window)
+    t_restored: float | None = None  # restore + step-fn rebuild complete
+    to_step: int | None = None       # snapshot step the resize restored
+    used_mirror: bool = False
+    dead_hosts: list[int] = dataclasses.field(default_factory=list)
+    abort_reason: str | None = None
+
+    @property
+    def terminal(self) -> bool:
+        return self.state in ("steady", "aborted")
+
+
+class _TrainTenant:
+    """The pool's training tenant: a step-driven mini-loop over the
+    trainer's own primitives (init_state / create_train_step /
+    split_put / synthetic_row_batches / SnapshotStore), emitting the
+    exact event schema the goodput ledger and trace tooling consume —
+    run_start, per-step ``step`` events, startup ``compile``, steady
+    ``recompile``, ``elastic_resize`` + ``aux_compile`` on resize."""
+
+    def __init__(
+        self,
+        model,
+        model_cfg,
+        cfg,                      # PoolConfig
+        hosts: VirtualHosts,
+        lease: set[int],
+        reg: MetricsRegistry,
+        *,
+        seed: int = 0,
+    ):
+        import jax
+
+        from dtc_tpu.config.schema import OptimConfig, TrainConfig
+        from dtc_tpu.obs.stepclock import CompileWatcher
+        from dtc_tpu.parallel.mesh import build_mesh
+        from dtc_tpu.parallel.sharding import DEFAULT_RULES, batch_spec
+        from dtc_tpu.train.train_step import create_train_step
+        from dtc_tpu.train.trainer import init_state
+
+        self.model = model
+        self.model_cfg = model_cfg
+        self.cfg = cfg
+        self.hosts = hosts
+        self.lease = set(lease)
+        self.reg = reg
+        self.seed = seed
+        self.rules = DEFAULT_RULES
+        self.spec = batch_spec(DEFAULT_RULES)
+        self.seq = model_cfg.max_seq_len + 1
+
+        # Monitor over the TRAIN lease only: construct over the full
+        # alive roster, then retire the serving hosts — they are another
+        # tenant's problem, not missing heartbeats.
+        self.monitor = HostMonitor(
+            hosts, miss_limit=cfg.heartbeat_miss_limit
+        )
+        for h in sorted(hosts.alive - self.lease):
+            self.monitor.retire(h)
+
+        # All compile seconds from here on are the train tenant's (the
+        # serving fleet warms up BEFORE this tenant is constructed).
+        self.compiles = CompileWatcher().activate()
+
+        self._train_cfg = TrainConfig(
+            seed=seed, parallel="dp", batch=cfg.global_batch,
+            steps=cfg.train_steps, log_every=1_000_000, output_dir="",
+        )
+        self._opt_cfg = OptimConfig(lr=1e-2, weight_decay=0.0, grad_clip=1.0)
+
+        devices = [d for h in sorted(self.lease)
+                   for d in hosts.devices_of(h)]
+        self.mesh = build_mesh(
+            (1, len(devices) // cfg.model_axis, cfg.model_axis),
+            devices=devices,
+        )
+        self.state = init_state(
+            model, model_cfg, self._train_cfg, self._opt_cfg, self.mesh,
+        )
+        self.step_fn = create_train_step(
+            self.mesh, model=model, state=self.state,
+        )
+        self.snapshots = SnapshotStore(
+            hosts, keep=cfg.snapshot_keep,
+            on_event=lambda etype, **f: self.reg.emit(etype, **f),
+        )
+        self.key = jax.random.PRNGKey(seed)
+        self.cur_step = 0
+        self.losses: list[float] = []
+        self.recompiles = 0
+        self._steady = False
+        self.data = self._make_data(start_row=0)
+
+        init_s, init_n = self.compiles.drain()
+        self.reg.emit(
+            "run_start", step=0, batch=cfg.global_batch,
+            seq_len=model_cfg.max_seq_len, devices=len(devices),
+            hosts=sorted(self.lease), pool=True,
+        )
+        if init_s > 0:
+            self.reg.emit(
+                "compile", step=0, compile_time_s=round(init_s, 6),
+                count=init_n,
+            )
+
+    # ------------------------------------------------------------------
+    def _make_data(self, start_row: int):
+        from dtc_tpu.data.synthetic import synthetic_row_batches
+
+        return synthetic_row_batches(
+            self.cfg.global_batch, self.seq, self.model_cfg.vocab_size,
+            seed=self.seed * 1000, start_row=start_row,
+        )
+
+    @property
+    def finished(self) -> bool:
+        return self.cur_step >= self.cfg.train_steps
+
+    @property
+    def per_device_batch(self) -> float:
+        n = len(self.lease) * self.hosts.per_host
+        return self.cfg.global_batch / max(n // self.cfg.model_axis, 1)
+
+    def step_once(self) -> float:
+        """One training step on the current mesh: data -> step -> beat
+        the monitor -> snapshot cadence -> step event. GLOBAL batch is
+        constant across resizes; the mesh's data axis decides the
+        per-device share."""
+        import jax
+
+        from dtc_tpu.data.prefetch import split_put
+        from dtc_tpu.train.train_step import Batch
+
+        self.cur_step += 1
+        t0 = time.perf_counter()
+        batch = next(self.data)
+        x, y = split_put(batch, self.mesh, self.spec)
+        with self.mesh:
+            self.state, loss = self.step_fn(
+                self.state, Batch(x=x, y=y),
+                jax.random.fold_in(self.key, self.cur_step),
+            )
+            loss = float(jax.block_until_ready(loss))
+        dur = time.perf_counter() - t0
+        comp_s, comp_n = self.compiles.drain()
+        fields: dict[str, Any] = {
+            "step": self.cur_step, "step_time_s": round(dur, 6),
+            "loss": round(loss, 6),
+        }
+        if comp_s > 0:
+            if self._steady:
+                self.recompiles += 1
+                self.reg.counter("recompiles").inc()
+                self.reg.emit(
+                    "recompile", step=self.cur_step,
+                    compile_s=round(comp_s, 6), count=comp_n,
+                )
+                fields["compile_s"] = round(comp_s, 6)
+            else:
+                self.reg.emit(
+                    "compile", step=0, compile_time_s=round(comp_s, 6),
+                    count=comp_n,
+                )
+        self._steady = True
+        self.reg.emit("step", **fields)
+        self.losses.append(loss)
+        self.monitor.tick(self.cur_step)
+        for ev in self.monitor.poll(self.cur_step):
+            self.reg.emit(ev.pop("kind"), **ev)
+        if self.cur_step % self.cfg.snapshot_every == 0:
+            t_snap0 = self.reg._clock()
+            self.snapshots.begin(self.cur_step, self.state)
+            # ``begin`` jit-compiles one tiny device copy per distinct
+            # leaf shape (first begin, and again after every resize's
+            # fresh shardings). Drain those NOW into their own
+            # ``aux_compile`` so they never masquerade as a step
+            # recompile — "exactly one recompile per mesh change" is an
+            # assertion, and it must count ONLY the step executable.
+            snap_s, snap_n = self.compiles.drain()
+            if snap_s > 0:
+                self.reg.emit(
+                    "aux_compile", step=self.cur_step, what="snapshot_copy",
+                    compile_s=round(snap_s, 6), count=snap_n,
+                )
+            t_snap1 = self.reg._clock()
+            # The synchronous half of the async snapshot (device copies
+            # dispatched on the hot loop before the commit thread takes
+            # over) is snapshot wall, not a mystery gap between steps.
+            # The compile portion is already billed by the aux_compile
+            # above (its interval ends ~t_snap1), so the dispatch span
+            # stops where that interval starts — no double-count.
+            disp = t_snap1 - t_snap0 - snap_s
+            if disp > 0.002:
+                self.reg.emit(
+                    "span", name="snapshot_dispatch", cat="pool", ph="X",
+                    tid="pool", t0=round(t_snap0, 6), dur_s=round(disp, 6),
+                    step=self.cur_step,
+                )
+        return loss
+
+    def resize(self, new_lease: set[int], *, reason: str) -> dict[str, Any]:
+        """Rebuild the mesh over ``new_lease`` (GROW or SHRINK) and
+        restore the newest complete snapshot onto it — shrink-and-
+        continue, both directions. Exactly one recompile follows at the
+        first post-resize step (the step executable's input shardings
+        changed); everything here is device_put + rebuild, attributed
+        via ``aux_compile`` if XLA compiles anything at all."""
+        from dtc_tpu.train.train_step import (
+            canonicalize_state_placement,
+            create_train_step,
+        )
+
+        t_detect = self.reg._clock()
+        self.snapshots.drain()
+        snap = self.snapshots.latest()
+        if snap is None:
+            raise ElasticAbort(
+                "pool resize: no complete snapshot to restore from"
+            )
+        new_mesh = resize_mesh(self.mesh, self.hosts, target_hosts=new_lease)
+        state, used_mirror = self.snapshots.restore(
+            snap, self.hosts.alive, new_mesh,
+        )
+        self.mesh = new_mesh
+        self.state = canonicalize_state_placement(state, new_mesh)
+        self.step_fn = create_train_step(
+            new_mesh, model=self.model, state=self.state,
+        )
+        # Re-seek the row stream by tokens consumed: the flat row stream
+        # is batch-shape-independent, and the global batch is constant,
+        # so rows consumed at the restored step = step x global_batch.
+        replayed = self.cur_step - snap.step
+        self.cur_step = snap.step
+        del self.losses[snap.step:]
+        self.data = self._make_data(start_row=snap.step * self.cfg.global_batch)
+        self.lease = set(new_lease)
+        comp_s, comp_n = self.compiles.drain()
+        t_restored = self.reg._clock()
+        n_dev = len(new_lease) * self.hosts.per_host
+        self.reg.emit(
+            "elastic_resize", step=snap.step, to_step=snap.step,
+            tier="memory", used_mirror=used_mirror, reason=reason,
+            devices=n_dev, hosts=sorted(new_lease),
+            per_device_batch=self.per_device_batch,
+            replayed_steps=replayed,
+            t_detect=round(t_detect, 6), t_restored=round(t_restored, 6),
+        )
+        if comp_s > 0:
+            self.reg.emit(
+                "aux_compile", step=snap.step, what="elastic_resize",
+                compile_s=round(comp_s, 6), count=comp_n,
+            )
+        return {
+            "to_step": snap.step, "used_mirror": used_mirror,
+            "t_detect": t_detect, "t_restored": t_restored,
+        }
+
+    def close(self) -> None:
+        self.snapshots.close()
+        self.compiles.deactivate()
+
+
+class PoolManager:
+    """See module docstring. Construct once per (model, params, pool
+    config); drive ``tick()`` (or ``run()``) — one tick is one unit of
+    time-sliced pool work: chaos consults, parked-request retries, one
+    fleet iteration, one transition edge, one training step, then the
+    arbitration decision."""
+
+    def __init__(
+        self,
+        model,
+        params: PyTree,
+        model_cfg,
+        cfg,                     # PoolConfig
+        *,
+        obs_dir: str = "",
+        seed: int = 0,
+        clock: Callable[[], float] = time.monotonic,
+        sleep: Callable[[float], None] = time.sleep,
+    ):
+        self.cfg = cfg
+        self.model_cfg = model_cfg
+        self.hosts = VirtualHosts(cfg.n_hosts)
+        if self.hosts.per_host * cfg.min_train_hosts < cfg.model_axis:
+            raise ElasticAbort(
+                f"model_axis={cfg.model_axis} cannot fit the minimum "
+                f"train lease ({cfg.min_train_hosts} hosts x "
+                f"{self.hosts.per_host} devices)"
+            )
+
+        all_hosts = list(range(cfg.n_hosts))
+        # Trainer leases the HIGH host ids; serving the low ones. LIFO
+        # surrender (most recently acquired first) keeps the baseline
+        # lease stable across a grow/shrink cycle.
+        self.train_lease: set[int] = set(all_hosts[-cfg.train_hosts:])
+        self._acquired: list[int] = []   # grow-acquired hosts, LIFO
+        serve0 = [h for h in all_hosts if h not in self.train_lease]
+
+        rcfg = dataclasses.replace(cfg.router, n_replicas=len(serve0))
+        self.router = FleetRouter(
+            model, params, rcfg, obs_dir=obs_dir,
+            router_proc=POOL_ROUTER_PROC, clock=clock, sleep=sleep,
+        )
+        self.serve_lease: dict[int, int] = {
+            h: rep.replica_id for h, rep in zip(serve0, self.router.replicas)
+        }
+        # Fleet jit happens HERE, before the train tenant activates its
+        # compile watcher — serving warmup must not masquerade as train
+        # compile time.
+        self.router.warmup([1, 2, 3])
+
+        self.reg = MetricsRegistry(process_index=POOL_TRAIN_PROC)
+        if obs_dir:
+            self.reg.add_sink(
+                JsonlSink(f"{obs_dir}/events.r{POOL_TRAIN_PROC}.jsonl")
+            )
+        self.trainer = _TrainTenant(
+            model, model_cfg, cfg, self.hosts, self.train_lease, self.reg,
+            seed=seed,
+        )
+
+        self.bus = RecoveryBus()
+        self.chaos = (
+            ChaosInjector(cfg.chaos, self.bus) if cfg.chaos.enabled else None
+        )
+        self.transition: PoolTransition | None = None
+        self.transitions: list[PoolTransition] = []
+        self._parked: list[Request] = []
+        self._parked_results: dict[str, ServeResult] = {}
+        self._grow_abort = False
+        self._idle_ticks = 0
+        self._spike_seq = 0
+        self._tick = 0
+
+    # ------------------------------------------------------------------
+    # request plane (zero silent drops)
+    # ------------------------------------------------------------------
+    def submit(self, req: Request) -> str:
+        """Route into the fleet; a request the fleet cannot admit right
+        now (saturated, or zero replicas mid-grow) PARKS in the pool's
+        pending queue — typed backpressure the pool itself retries, so
+        a transition never sheds a request silently."""
+        try:
+            return self.router.submit(req)
+        except (FleetSaturatedError, QueueFullError) as e:
+            self._parked.append(req)
+            self.reg.emit(
+                "pool_request_parked", rid=req.rid, tick=self._tick,
+                error=type(e).__name__, parked=len(self._parked),
+            )
+            return req.rid
+
+    def _unpark(self) -> None:
+        while self._parked:
+            req = self._parked[0]
+            try:
+                self.router.submit(req)
+            except (FleetSaturatedError, QueueFullError):
+                return
+            self._parked.pop(0)
+            self.reg.emit(
+                "pool_request_unparked", rid=req.rid, tick=self._tick,
+                parked=len(self._parked),
+            )
+
+    def results(self) -> dict[str, ServeResult]:
+        """Fleet terminals + the pool backstop's typed terminals."""
+        out = dict(self.router.results)
+        out.update(self._parked_results)
+        return out
+
+    def _emit_timeshare(self, t0: float, t1: float) -> None:
+        if t1 - t0 > 0.002:
+            self.reg.emit(
+                "span", name="pool.timeshare", cat="pool", ph="X",
+                tid="pool", t0=round(t0, 6), dur_s=round(t1 - t0, 6),
+            )
+
+    # ------------------------------------------------------------------
+    # the tick loop
+    # ------------------------------------------------------------------
+    def tick(self) -> bool:
+        """One pool iteration. Returns True while anything is in
+        flight: training budget unfinished, requests live anywhere, or
+        a transition mid-walk."""
+        self._tick += 1
+        t_serve0 = self.reg._clock()
+        self._consult_chaos()
+        self._unpark()
+        if self.router.live_replicas:
+            self.router.step()
+        t_serve1 = self.reg._clock()
+        # The train tenant's CPU slice yielded to the co-tenant serving
+        # fleet this tick (one process time-slices every pool "host").
+        # Typed yields on the train shard's timeline — the goodput
+        # ledger classes them shed_or_idle(cause=timeshare) instead of
+        # leaving unattributed holes between steps. On a real pod the
+        # tenants own disjoint machines and these spans have zero width.
+        self._emit_timeshare(t_serve0, t_serve1)
+        pre_resized = (
+            self.transition is not None
+            and self.transition.state == "resized"
+        )
+        self._advance_transition()
+        t_adv = self.reg._clock()
+        tr = self.transition
+        if (tr is not None and tr.state == "resized" and not pre_resized
+                and tr.t_detect is not None and tr.t_restored is not None):
+            # The transition walk just resized: its [t_detect, t_restored]
+            # window is already typed elastic_resize(cause=restore) by the
+            # incident — the timeshare pieces are only the fleet work
+            # around it (retire/spawn/lease bookkeeping).
+            self._emit_timeshare(t_serve1, tr.t_detect)
+            self._emit_timeshare(tr.t_restored, t_adv)
+        else:
+            self._emit_timeshare(t_serve1, t_adv)
+        tr = self.transition
+        can_step = not self.trainer.finished and (
+            tr is None or tr.state in ("requested", "draining", "resized")
+        )
+        if can_step:
+            self.trainer.step_once()
+            if tr is not None and tr.state == "resized":
+                # The first post-resize step just ran (and paid the mesh
+                # change's one recompile) — the transition is steady.
+                self._advance(tr, "steady")
+                self.transition = None
+        elif tr is not None and tr.state == "resized" and self.trainer.finished:
+            # Resize landed ON the budget boundary: no further step will
+            # ever run (so no recompile is owed) — steady immediately.
+            self._advance(tr, "steady")
+            self.transition = None
+        if self.transition is None:
+            self._arbitrate()
+        self._drain_bus()
+        return (
+            not self.trainer.finished
+            or bool(self.router.records)
+            or bool(self._parked)
+            or self.transition is not None
+        )
+
+    def run(self, *, max_ticks: int = 10_000) -> None:
+        for _ in range(max_ticks):
+            if not self.tick():
+                return
+
+    # ------------------------------------------------------------------
+    # chaos (deferred-fire: consulted only while the named transition
+    # is actually in flight, so the shot lands on a production path)
+    # ------------------------------------------------------------------
+    def _consult_chaos(self) -> None:
+        tr = self.transition
+        if self.chaos is None or tr is None or tr.terminal:
+            return
+        if tr.kind == "grow":
+            burst = self.chaos.pool_spike_mid_grow(self._tick)
+            if burst:
+                self._inject_spike(burst)
+                if tr.state in ("requested", "draining", "reassigned"):
+                    # Mesh not rebuilt yet: abort cleanly. Past that
+                    # point the grow completes and the spike pressure
+                    # drives an immediate shrink through arbitration.
+                    self._grow_abort = True
+            if tr.state == "draining" and self.chaos.pool_kill_draining_replica(
+                self._tick
+            ):
+                for rid in tr.replicas:
+                    rep = self.router.replicas[rid]
+                    if rep.state is ReplicaState.DRAINING and rep.load > 0:
+                        self.router.kill_replica(
+                            rid, reason="chaos pool_kill_draining_replica",
+                        )
+                        break
+        if tr.kind == "shrink" and tr.state in ("requested", "draining"):
+            victim = self.chaos.pool_kill_mid_shrink(self._tick)
+            if victim is not None:
+                self._kill_host(victim, why="pool_kill_mid_shrink")
+
+    def _inject_spike(self, burst: int) -> None:
+        rng = np.random.RandomState(7_000 + self._tick)
+        prompts = seeded_prompts(
+            rng, burst, 8, self.model_cfg.vocab_size,
+        )
+        mnt = min(8, self.cfg.router.serve.max_new_tokens)
+        self.reg.emit("pool_spike", requests=burst, tick=self._tick)
+        for p in prompts:
+            self._spike_seq += 1
+            self.submit(Request(
+                rid=f"spike{self._spike_seq}", prompt=p, max_new_tokens=mnt,
+            ))
+
+    def _kill_host(self, victim: int, *, why: str) -> None:
+        """A host dies: its devices leave the alive set and its snapshot
+        RAM (primary AND held mirrors) vanishes — recovery must source
+        the ring mirror on a SURVIVOR, never the corpse. A serve-leased
+        victim takes its replica down with it (the router fails over its
+        in-flight requests) and surrenders the lease for good: a dead
+        host must never be leased back to either tenant."""
+        self.hosts.kill(victim)
+        self.trainer.snapshots.drop_primary(victim)
+        rid = self.serve_lease.pop(victim, None)
+        if rid is not None:
+            self.router.kill_replica(rid, reason=f"chaos {why}")
+        tr = self.transition
+        if tr is not None and not tr.terminal:
+            # Any host that dies while a transition is in flight lands on
+            # that transition's bill — the kill need not hit a host being
+            # surrendered to count against the surrender's safety story.
+            tr.dead_hosts.append(victim)
+        self.reg.emit(
+            "pool_host_killed", host=victim, why=why, tick=self._tick,
+            replica=rid,
+        )
+
+    # ------------------------------------------------------------------
+    # the typed state machine
+    # ------------------------------------------------------------------
+    def _advance(self, tr: PoolTransition, state: str, **fields: Any) -> None:
+        if state not in _TRANSITION_EDGES[tr.state]:
+            raise RuntimeError(
+                f"illegal pool transition edge {tr.state} -> {state} "
+                f"({tr.kind} {tr.hosts})"
+            )
+        prev, tr.state = tr.state, state
+        self.reg.emit(
+            "pool_transition", kind=tr.kind, hosts=list(tr.hosts),
+            prev=prev, state=state, tick=self._tick,
+            requested_tick=tr.tick, **fields,
+        )
+
+    def _request(self, kind: str, hosts: list[int], replicas: list[int]) -> None:
+        tr = PoolTransition(
+            kind=kind, hosts=list(hosts), tick=self._tick,
+            replicas=list(replicas), t_requested=self.reg._clock(),
+        )
+        self.transition = tr
+        self.transitions.append(tr)
+        self._grow_abort = False
+        self.reg.emit(
+            "pool_transition", kind=kind, hosts=list(hosts), prev=None,
+            state="requested", tick=self._tick, requested_tick=self._tick,
+            replicas=list(replicas),
+        )
+
+    def _advance_transition(self) -> None:
+        tr = self.transition
+        if tr is None or tr.terminal:
+            return
+        if tr.kind == "grow":
+            self._advance_grow(tr)
+        else:
+            self._advance_shrink(tr)
+        if tr.terminal and tr.state == "aborted":
+            self.transition = None
+
+    # -- grow: serve -> train ------------------------------------------
+    def _advance_grow(self, tr: PoolTransition) -> None:
+        if self._grow_abort and tr.state in (
+            "requested", "draining", "reassigned"
+        ):
+            self._abort_grow(tr, reason="load_spike")
+            return
+        if tr.state == "requested":
+            for rid in tr.replicas:
+                self.router.begin_retire(rid, reason="pool_grow")
+            self._advance(tr, "draining")
+        elif tr.state == "draining":
+            done = True
+            for rid in tr.replicas:
+                rep = self.router.replicas[rid]
+                if rep.state is ReplicaState.DEAD:
+                    continue  # chaos-killed mid-drain: failover ran, host free
+                if not self.router.finish_retire(rid, reason="pool_grow"):
+                    done = False
+            if done:
+                self._advance(tr, "reassigned")
+        elif tr.state == "reassigned":
+            # Hosts leave the serve lease and join the monitor roster —
+            # admit() refuses a host the monitor believes dead, which
+            # aborts the grow instead of resurrecting a corpse.
+            try:
+                for h in tr.hosts:
+                    if h not in self.hosts.alive:
+                        raise ElasticAbort(
+                            f"grow target host {h} is dead"
+                        )
+                    self.trainer.monitor.admit(h, step=self.trainer.cur_step)
+            except ElasticAbort as e:
+                self._abort_grow(tr, reason=str(e))
+                return
+            for h in tr.hosts:
+                self.serve_lease.pop(h, None)
+            self.train_lease |= set(tr.hosts)
+            self._acquired.extend(tr.hosts)
+            info = self.trainer.resize(
+                set(self.train_lease), reason="pool_grow",
+            )
+            tr.to_step = info["to_step"]
+            tr.used_mirror = info["used_mirror"]
+            tr.t_detect, tr.t_restored = info["t_detect"], info["t_restored"]
+            self._advance(
+                tr, "resized", to_step=tr.to_step,
+                used_mirror=tr.used_mirror,
+                devices=len(self.train_lease) * self.hosts.per_host,
+            )
+
+    def _abort_grow(self, tr: PoolTransition, *, reason: str) -> None:
+        """Roll a not-yet-resized grow back: draining replicas resume
+        accepting, fully-retired ones are respawned (zero compiles via
+        the fn cache), any monitor admissions are retired again. The
+        trainer's mesh was never touched; parked requests drain on the
+        restored capacity."""
+        for h, rid in zip(tr.hosts, tr.replicas):
+            self.trainer.monitor.retire(h)
+            rep = self.router.replicas[rid]
+            if rep.state is ReplicaState.DRAINING:
+                self.router.cancel_retire(rid, reason="pool_grow_abort")
+                self.serve_lease[h] = rid
+            elif rep.state is ReplicaState.DEAD and h in self.hosts.alive:
+                new = self.router.spawn_replica()
+                self.serve_lease[h] = new.replica_id
+            self.train_lease.discard(h)
+            if h in self._acquired:
+                self._acquired.remove(h)
+        tr.abort_reason = reason
+        self._grow_abort = False
+        self.reg.emit(
+            "pool_grow_abort", hosts=list(tr.hosts), reason=reason,
+            tick=self._tick,
+        )
+        self._advance(tr, "aborted", reason=reason)
+
+    # -- shrink: train -> serve ----------------------------------------
+    def _advance_shrink(self, tr: PoolTransition) -> None:
+        if tr.state == "requested":
+            # The surrender is safe BEFORE it starts: every queued
+            # snapshot commit lands now, so a complete snapshot covers
+            # the current step (ring-mirrored — a victim dying mid-
+            # surrender cannot take the only copy with it).
+            self.trainer.snapshots.drain()
+            self._advance(tr, "draining")
+        elif tr.state == "draining":
+            for h in tr.hosts:
+                # Deliberate surrender, not death: the host leaves the
+                # roster cleanly and a later admit() of it is legal.
+                self.trainer.monitor.retire(h)
+                self.train_lease.discard(h)
+                if h in self._acquired:
+                    self._acquired.remove(h)
+            self._advance(tr, "reassigned")
+        elif tr.state == "reassigned":
+            info = self.trainer.resize(
+                set(self.train_lease), reason="pool_shrink",
+            )
+            tr.to_step = info["to_step"]
+            tr.used_mirror = info["used_mirror"]
+            tr.t_detect, tr.t_restored = info["t_detect"], info["t_restored"]
+            spawned = []
+            for h in tr.hosts:
+                if h not in self.hosts.alive:
+                    continue  # died mid-surrender: nothing to serve on
+                rep = self.router.spawn_replica()
+                self.serve_lease[h] = rep.replica_id
+                spawned.append(rep.replica_id)
+            tr.replicas = spawned
+            self._advance(
+                tr, "resized", to_step=tr.to_step,
+                used_mirror=tr.used_mirror, spawned=spawned,
+                dead_hosts=list(tr.dead_hosts),
+                devices=len(self.train_lease) * self.hosts.per_host,
+            )
+
+    # ------------------------------------------------------------------
+    # arbitration
+    # ------------------------------------------------------------------
+    def _arbitrate(self) -> None:
+        accepting = [r for r in self.router.replicas if r.accepting]
+        backlog = len(self._parked) + sum(r.load for r in accepting)
+        spike = (
+            (not accepting and bool(self._parked))
+            or (bool(accepting)
+                and backlog / len(accepting) >= self.cfg.spike_queue_depth)
+        )
+        if spike:
+            self._idle_ticks = 0
+            victims = self._shrink_victims()
+            if victims:
+                self._request("shrink", victims, [])
+            return
+        if backlog == 0 and not self.router.records:
+            self._idle_ticks += 1
+        else:
+            self._idle_ticks = 0
+        if (
+            self._idle_ticks >= self.cfg.grow_after_idle_ticks
+            and not self.trainer.finished
+        ):
+            hosts, reps = self._grow_candidates()
+            if hosts:
+                self._idle_ticks = 0
+                self._request("grow", hosts, reps)
+
+    def _shrink_victims(self) -> list[int]:
+        """LIFO: grow-acquired hosts surrender first (back to the
+        configured baseline); under sustained pressure the baseline
+        itself shrinks one host at a time down to min_train_hosts."""
+        if not self.trainer.finished and len(self.train_lease) <= \
+                self.cfg.min_train_hosts:
+            return []
+        if self._acquired:
+            return list(reversed(self._acquired))
+        if len(self.train_lease) > self.cfg.min_train_hosts:
+            return [max(self.train_lease)]
+        return []
+
+    def _grow_candidates(self) -> tuple[list[int], list[int]]:
+        """Serve hosts whose replicas are idle, above the serve floor —
+        the LARGEST prefix that still yields a valid mesh and batch
+        split (a dead host can leave the full idle set indivisible;
+        growing by fewer hosts beats not growing at all)."""
+        idle = [
+            (h, rid) for h, rid in sorted(self.serve_lease.items())
+            if h in self.hosts.alive
+            and self.router.replicas[rid].accepting
+            and self.router.replicas[rid].load == 0
+        ]
+        n_take = len(self.serve_lease) - self.cfg.min_serve_hosts
+        for k in range(min(len(idle), max(n_take, 0)), 0, -1):
+            take = idle[:k]
+            new_lease = self.train_lease | {h for h, _ in take}
+            n_dev = len(new_lease) * self.hosts.per_host
+            if n_dev % self.cfg.model_axis == 0 and \
+                    self.cfg.global_batch % (n_dev // self.cfg.model_axis) == 0:
+                return [h for h, _ in take], [rid for _, rid in take]
+        return [], []
+
+    # ------------------------------------------------------------------
+    # plumbing
+    # ------------------------------------------------------------------
+    def _drain_bus(self) -> None:
+        for etype, fields in self.bus.drain():
+            fields.setdefault("tick", self._tick)
+            self.reg.emit(etype, **fields)
+
+    def summary(self) -> dict[str, Any]:
+        fleet = self.router.fleet_summary()
+        return {
+            "ticks": self._tick,
+            "train_steps": self.trainer.cur_step,
+            "train_hosts": sorted(self.train_lease),
+            "serve_hosts": sorted(self.serve_lease),
+            "recompiles": self.trainer.recompiles,
+            "transitions": [
+                {
+                    "kind": t.kind, "hosts": t.hosts, "state": t.state,
+                    "to_step": t.to_step, "used_mirror": t.used_mirror,
+                    "dead_hosts": t.dead_hosts,
+                    "abort_reason": t.abort_reason,
+                }
+                for t in self.transitions
+            ],
+            "parked_unserved": len(self._parked),
+            "fleet": fleet,
+        }
+
+    def close(self, *, drain: bool = True) -> dict[str, ServeResult]:
+        """Drain the fleet, reconcile every still-parked request to a
+        typed FAILED terminal (the zero-silent-drop backstop), release
+        tenants, and return the full terminal map."""
+        if drain and self.router.live_replicas:
+            self.router.drain()
+        for req in self._parked:
+            res = ServeResult(
+                rid=req.rid, state=RequestState.FAILED, tokens=[],
+                error=RequestFailedError(
+                    f"request {req.rid}: pool closed before any replica "
+                    "could admit it"
+                ),
+                finished_t=self.router.clock(),
+            )
+            self._parked_results[req.rid] = res
+            self.router.reg.emit(
+                "serve_request", iteration=self._tick, **res.summary(),
+            )
+        self._parked.clear()
+        self.reg.emit("pool_closed", tick=self._tick)
+        self.reg.flush()
+        self.reg.close()
+        self.router.close()
+        self.trainer.close()
+        return self.results()

@@ -266,6 +266,50 @@ class ChaosInjector:
             return False
         return self._damage_dir(step_dir, self.cfg.corrupt_mode)
 
+    # ---- pool plane (dtc_tpu/pool/ — step numbers are POOL ticks; the
+    # PoolManager consults these at its transition boundaries so every
+    # fault lands on the production resize/spawn/retire paths) ----------
+    def pool_spike_mid_grow(self, it: int) -> int:
+        """Request burst (returned size, 0 = no fault) injected while a
+        trainer GROW transition is in flight: the pool must either abort
+        the grow cleanly (devices return to serving, parked requests
+        drain) or complete it and immediately shrink back — in-flight
+        requests are never shed silently either way. Deferred-fire
+        contract like :meth:`serve_preempt`: the pool consults this only
+        while a grow is actually mid-transition, so the shot is never
+        wasted on steady state."""
+        if 0 < self.cfg.pool_spike_mid_grow_at <= it and self._fire(
+            "pool_spike_mid_grow", iteration=it,
+            requests=self.cfg.pool_spike_requests,
+        ):
+            return self.cfg.pool_spike_requests
+        return 0
+
+    def pool_kill_mid_shrink(self, it: int) -> int | None:
+        """Victim host (None = no fault) that dies while the trainer is
+        SURRENDERING devices (shrink in flight): the host's snapshot
+        primaries vanish with it, so the restore onto the smaller mesh
+        must come from the ring mirror — the surrender is safe because
+        redundancy, not the victim, holds the bytes. Deferred-fire: the
+        pool consults this only while a shrink is mid-transition."""
+        if 0 < self.cfg.pool_kill_mid_shrink_at <= it and self._fire(
+            "pool_kill_mid_shrink", iteration=it,
+            host=self.cfg.elastic_target_host,
+        ):
+            return self.cfg.elastic_target_host
+        return None
+
+    def pool_kill_draining_replica(self, it: int) -> bool:
+        """Kill the replica being retired mid-drain: its in-flight
+        requests must fail over to surviving replicas via the PR 12
+        router path, token-identical, zero silent drops. Deferred-fire:
+        the pool consults this only while a retirement drain is in
+        flight (so a draining replica with live requests exists)."""
+        return (
+            0 < self.cfg.pool_kill_draining_replica_at <= it
+            and self._fire("pool_kill_draining_replica", iteration=it)
+        )
+
     @staticmethod
     def _damage_dir(step_dir: str, mode: str) -> bool:
         """Damage the largest file under ``step_dir`` (shared by the

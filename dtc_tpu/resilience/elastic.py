@@ -30,11 +30,13 @@ shrink-and-continue (MegaScale / Gemini style):
   late (chaos ``slow_host_at_step``, a straggler) is flagged
   ``host_slow`` exactly once and must NOT be declared lost.
 
-- :func:`shrink_mesh` — rebuild the mesh from the survivors' devices:
-  pipe/model axis sizes are preserved (elastic shrink removes whole
-  data-parallel groups), the data axis absorbs the survivors. Raises
-  :class:`ElasticAbort` when no valid smaller mesh exists (survivors
-  not divisible by the model axis, pipeline runs).
+- :func:`resize_mesh` — rebuild the mesh from a target host set, SHRINK
+  or GROW: pipe/model axis sizes are preserved (elastic resize moves
+  whole data-parallel groups), the data axis absorbs the targets.
+  Raises :class:`ElasticAbort` when no valid mesh exists (targets not
+  divisible by the model axis, pipeline runs, dead targets).
+  :func:`shrink_mesh` is the survivors-only delegate the trainer's
+  shrink-and-continue path has always used.
 """
 
 from __future__ import annotations
@@ -77,6 +79,15 @@ class VirtualHosts:
 
     def kill(self, host: int) -> None:
         self.alive.discard(host)
+
+    def revive(self, host: int) -> None:
+        """Return ``host`` to the alive set (pool GROW hands a host back
+        after the serving tenant released it — the emulation of a fresh
+        host joining at the same pod slot). The caller is responsible for
+        monitor admission: ``alive`` is capacity, not health history."""
+        if not 0 <= host < self.n_hosts:
+            raise ValueError(f"host {host} outside pool of {self.n_hosts}")
+        self.alive.add(host)
 
     def ring_next(self, host: int) -> int:
         return (host + 1) % self.n_hosts
@@ -122,6 +133,8 @@ class HostMonitor:
             for h in self._roster:
                 self._last_beat[h] = step - 1
         for h in self.hosts.alive:
+            if h not in self._roster:
+                continue  # another tenant's host (pool): not ours to beat
             if self._slow_until.get(h, 0) >= step:
                 continue  # straggling: the beat does not arrive this step
             self._last_beat[h] = step
@@ -161,32 +174,92 @@ class HostMonitor:
     def lost(self) -> set[int]:
         return set(self._lost)
 
+    # ---- roster transitions (pool GROW/SHRINK) ---------------------------
+    def admit(self, host: int, *, step: int) -> None:
+        """Add ``host`` to the monitored roster (pool GROW: the serving
+        tenant released the host and the trainer is absorbing it).
 
-def shrink_mesh(mesh: Any, hosts: VirtualHosts) -> Any:
-    """Rebuild the mesh over the surviving hosts' devices.
+        A host this monitor has DECLARED LOST is refused: a grow must
+        never resurrect a host the detector believes dead — the pool's
+        emulation would silently launder a failure into fresh capacity.
+        The pool hands back a different host (or nothing) instead."""
+        if host in self._lost:
+            raise ElasticAbort(
+                f"cannot admit host {host}: declared lost at beat "
+                f"{self._last_beat.get(host, '?')} — a grow must not "
+                "resurrect a dead host"
+            )
+        if host not in self._roster:
+            self._roster = sorted(set(self._roster) | {host})
+        # Seed the beat NOW: the host is healthy at admission, and the
+        # next missed beat (not the whole pre-admission gap) starts the
+        # miss count.
+        self._last_beat[host] = step
+        self._slow_flagged.discard(host)
 
-    Shrink happens along the "data" axis only (whole DP/FSDP groups
-    leave); "model" (TP) groups must stay intact — a lost host that
-    takes part of every TP group with it leaves no valid smaller mesh.
+    def retire(self, host: int) -> None:
+        """Remove ``host`` from the roster (pool SHRINK: the trainer is
+        deliberately surrendering the host to the serving tenant).
+        Deliberate surrender is not death: the host leaves the beat
+        table entirely so ``poll`` never declares it lost, and a later
+        ``admit`` of the same host is legal."""
+        self._roster = sorted(set(self._roster) - {host})
+        self._last_beat.pop(host, None)
+        self._slow_until.pop(host, None)
+        self._slow_flagged.discard(host)
+
+
+def resize_mesh(
+    mesh: Any, hosts: VirtualHosts, target_hosts: set[int] | None = None
+) -> Any:
+    """Rebuild the mesh over ``target_hosts``' devices — SHRINK or GROW.
+
+    ``target_hosts=None`` means "every currently alive host" (the
+    shrink-and-continue path: survivors absorb the data axis). An
+    explicit host set is the pool's resize seam: GROW is
+    shrink-and-continue in reverse — the caller restores the newest
+    complete snapshot onto the larger mesh with fresh NamedShardings.
+
+    Resize happens along the "data" axis only (whole DP/FSDP groups
+    enter or leave); "model" (TP) groups must stay intact — a target
+    set that breaks every TP group leaves no valid mesh.
     """
     from dtc_tpu.parallel.mesh import build_mesh
 
-    survivors = hosts.survivor_devices()
-    if not survivors:
-        raise ElasticAbort("no surviving hosts to rebuild a mesh from")
+    if target_hosts is None:
+        devices = hosts.survivor_devices()
+    else:
+        bad = set(target_hosts) - hosts.alive
+        if bad:
+            raise ElasticAbort(
+                f"resize targets dead/unknown hosts {sorted(bad)} "
+                f"(alive: {sorted(hosts.alive)})"
+            )
+        devices = [
+            d for h in sorted(target_hosts) for d in hosts.devices_of(h)
+        ]
+    if not devices:
+        raise ElasticAbort("no surviving target hosts to rebuild a mesh from")
     shape = dict(mesh.shape)
     pipe = int(shape.get("pipe", 1))
     model = int(shape.get("model", 1))
     if pipe > 1:
         raise ElasticAbort(
-            "elastic shrink is not supported under pipeline parallelism "
-            "(stage-chunked params cannot re-shard onto fewer stages); "
-            "use a mesh with pipe == 1"
+            "elastic resize is not supported under pipeline parallelism "
+            "(stage-chunked params cannot re-shard onto a different "
+            "stage count); use a mesh with pipe == 1"
         )
-    if len(survivors) % model != 0:
+    if len(devices) % model != 0:
         raise ElasticAbort(
-            f"{len(survivors)} surviving devices do not preserve the "
-            f"model={model} (TP) axis; no valid shrunk mesh exists"
+            f"{len(devices)} target devices do not preserve the "
+            f"model={model} (TP) axis; no valid resized mesh exists"
         )
-    new_data = len(survivors) // model
-    return build_mesh((1, new_data, model), devices=survivors)
+    new_data = len(devices) // model
+    return build_mesh((1, new_data, model), devices=devices)
+
+
+def shrink_mesh(mesh: Any, hosts: VirtualHosts) -> Any:
+    """Rebuild the mesh over the surviving hosts' devices (the original
+    shrink-and-continue entrypoint — now a thin delegate of
+    :func:`resize_mesh` with the survivors as the target set)."""
+    return resize_mesh(mesh, hosts, target_hosts=None)

@@ -45,6 +45,16 @@ class ElasticAbort(ResilienceError):
     on a reprovisioned slice from the last cold-tier checkpoint."""
 
 
+class ChaosTargetError(ResilienceError):
+    """A chaos fault became actionable but its configured victim does not
+    exist at FIRE time (e.g. ``fleet_target_replica`` names a replica id
+    that was never spawned or has already been retired). With spawn/
+    retire the replica set is dynamic, so this is judged when the fault
+    fires, not at config construction — and a stale target is a typed
+    error, never a silent no-op: a chaos drill that silently skips its
+    injection would report a vacuous pass."""
+
+
 class ChaosInjectedError(ConnectionError):
     """Deterministic fault raised by the chaos harness into the data plane.
 

@@ -109,19 +109,29 @@ class FleetRouter:
         cfg,
         *,
         obs_dir: str = "",
+        router_proc: int | None = None,
         clock: Callable[[], float] = time.monotonic,
         sleep: Callable[[float], None] = time.sleep,
     ):
         self.cfg = cfg
         self.clock = clock
         self.sleep = sleep
-        # Fleet-level registry: process index ONE PAST the replicas, so
-        # router events/spans land on their own shard/track next to the
-        # per-replica ones in every merged view.
-        self.reg = MetricsRegistry(process_index=cfg.n_replicas)
+        # Retained for dynamic spawn (ISSUE 17): a replica spawned later
+        # serves the SAME (model, params) — which is also what makes the
+        # engine fn cache a zero-compile spawn.
+        self.model = model
+        self.params = params
+        self._obs_dir = obs_dir
+        # Fleet-level registry: process index ONE PAST the replicas by
+        # default, so router events/spans land on their own shard/track
+        # next to the per-replica ones in every merged view. A caller
+        # that spawns replicas dynamically (the pool) passes an explicit
+        # router_proc well above any replica id it will ever mint.
+        self._proc = cfg.n_replicas if router_proc is None else router_proc
+        self.reg = MetricsRegistry(process_index=self._proc)
         if obs_dir:
             self.reg.add_sink(
-                JsonlSink(f"{obs_dir}/events.r{cfg.n_replicas}.jsonl")
+                JsonlSink(f"{obs_dir}/events.r{self._proc}.jsonl")
             )
         self.tracer = Tracer(self.reg, tid="router")
         self.recorder = self.reg.add_sink(FlightRecorder(256))
@@ -129,20 +139,6 @@ class FleetRouter:
         self.chaos = (
             ChaosInjector(cfg.chaos, self.bus) if cfg.chaos.enabled else None
         )
-
-        self.replicas: list[EngineReplica] = []
-        for i in range(cfg.n_replicas):
-            eng = ServingEngine(
-                model, params, cfg.serve, clock=clock, sleep=sleep
-            )
-            # Per-replica fleet observability rides the existing
-            # multi-host machinery: the replica id IS the shard index.
-            eng.reg.process_index = i
-            if obs_dir:
-                eng.reg.add_sink(JsonlSink(f"{obs_dir}/events.r{i}.jsonl"))
-            self.replicas.append(EngineReplica(
-                i, eng, watchdog_cfg=cfg.watchdog, clock=clock,
-            ))
 
         self.records: dict[str, FleetRecord] = {}   # in flight, fleet-wide
         self.results: dict[str, ServeResult] = {}   # fleet-terminal
@@ -153,6 +149,12 @@ class FleetRouter:
         self._it = 0
         self._sigterm = False
         self._prev_sigterm_handler: Any = None
+
+        # Append-only: a retired/dead replica keeps its slot (DEAD), so
+        # replica_id == list index holds across spawn/retire.
+        self.replicas: list[EngineReplica] = []
+        for _ in range(cfg.n_replicas):
+            self.spawn_replica(quiet=True)
 
     # ------------------------------------------------------------------
     # tenants
@@ -334,20 +336,24 @@ class FleetRouter:
         request is in flight anywhere."""
         self._it += 1
         if self.chaos is not None:
-            tgt = min(
-                self.cfg.chaos.fleet_target_replica, len(self.replicas) - 1
-            )
+            # Victims resolve at FIRE time (the replica set is dynamic
+            # under spawn/retire): a stale target raises a typed
+            # ChaosTargetError instead of clamping to some other replica
+            # or silently no-oping.
             stall = self.chaos.fleet_stall_replica(self._it)
             if stall > 0:
-                self.replicas[tgt].stall(stall)
+                self._chaos_target("fleet_stall_replica").stall(stall)
             part = self.chaos.fleet_partition(self._it)
             if part > 0:
-                self.replicas[tgt].partition(part)
+                self._chaos_target("fleet_partition").partition(part)
             # Kill consults only with traffic in flight (the deferred-fire
             # contract: killing an idle fleet would burn the shot on an
             # injection that proves nothing).
             if self.records and self.chaos.fleet_kill_replica(self._it):
-                self.kill_replica(tgt, reason="chaos")
+                self.kill_replica(
+                    self._chaos_target("fleet_kill_replica").replica_id,
+                    reason="chaos",
+                )
         for rep in self.replicas:
             if rep.state is ReplicaState.DEAD:
                 continue
@@ -418,6 +424,121 @@ class FleetRouter:
                 )
         if res.n_hops > 0:
             self.reg.counter("router_failover_terminals").inc()
+
+    # ------------------------------------------------------------------
+    # spawn / retire (the pool seam, ISSUE 17)
+    # ------------------------------------------------------------------
+    def spawn_replica(self, *, quiet: bool = False) -> EngineReplica:
+        """Bring up one more replica of the router's (model, params).
+
+        The engine-level fn cache means a same-(model, page_size) spawn
+        compiles ZERO times — the new replica shares the already-jitted
+        prefill/decode executables, so spawning under load costs queue
+        plumbing, not a compile. The new id is the next list slot
+        (append-only invariant: replica_id == index, retired replicas
+        keep their DEAD slot)."""
+        rid = len(self.replicas)
+        if rid == self._proc:
+            raise ValueError(
+                f"replica id {rid} would collide with the router's own "
+                f"obs shard (process index {self._proc}); construct the "
+                "router with an explicit router_proc above every replica "
+                "id it may mint"
+            )
+        eng = ServingEngine(
+            self.model, self.params, self.cfg.serve,
+            clock=self.clock, sleep=self.sleep,
+        )
+        # Per-replica fleet observability rides the existing multi-host
+        # machinery: the replica id IS the shard index.
+        eng.reg.process_index = rid
+        if self._obs_dir:
+            eng.reg.add_sink(JsonlSink(f"{self._obs_dir}/events.r{rid}.jsonl"))
+        rep = EngineReplica(
+            rid, eng, watchdog_cfg=self.cfg.watchdog, clock=self.clock,
+        )
+        self.replicas.append(rep)
+        if not quiet:  # construction-time spawns are not events
+            self.reg.counter("router_spawns").inc()
+            self.reg.emit(
+                "router_replica_spawn", replica=rid, iteration=self._it,
+            )
+        return rep
+
+    def begin_retire(self, replica_id: int, *, reason: str = "retire") -> None:
+        """Stage 1 of retirement: stop routing NEW work to the replica
+        (DRAINING is not ``accepting``) while its in-flight requests
+        keep decoding through the normal ``step()`` loop. Staged — not
+        an atomic drain — so a mid-drain death lands on the production
+        failover path instead of inside a blocking loop."""
+        rep = self.replicas[replica_id]
+        if rep.state in (ReplicaState.DEAD, ReplicaState.DRAINING):
+            return
+        self._transition(rep, ReplicaState.DRAINING, reason)
+
+    def finish_retire(
+        self, replica_id: int, *, reason: str = "retired"
+    ) -> bool:
+        """Stage 2: once the draining replica is empty, run the engine
+        shutdown contract (bus drained, flight dumped) and park it DEAD
+        ("retired"). Returns False while in-flight work remains — the
+        caller keeps stepping the fleet and asks again."""
+        rep = self.replicas[replica_id]
+        if rep.state is ReplicaState.DEAD:
+            return True
+        if rep.state is not ReplicaState.DRAINING:
+            raise ValueError(
+                f"replica {replica_id} is {rep.state.value}, not draining "
+                "(call begin_retire first)"
+            )
+        if rep.load > 0:
+            return False
+        rep.engine.shutdown(
+            mode="drain", max_steps=self.cfg.drain_max_steps,
+            reason=f"{reason} (replica {replica_id})",
+        )
+        self._pull(rep)
+        self._transition(rep, ReplicaState.DEAD, reason)
+        self.reg.counter("router_retires").inc()
+        return True
+
+    def cancel_retire(
+        self, replica_id: int, *, reason: str = "retire_cancelled"
+    ) -> None:
+        """Roll stage 1 back: a DRAINING replica resumes accepting
+        (pool grow-abort — the capacity is needed for serving after
+        all). In-flight work was never disturbed, so this is just the
+        reverse state edge; anything else than DRAINING is an error
+        because there is nothing to cancel."""
+        rep = self.replicas[replica_id]
+        if rep.state is not ReplicaState.DRAINING:
+            raise ValueError(
+                f"replica {replica_id} is {rep.state.value}, not draining "
+                "(nothing to cancel)"
+            )
+        self._transition(rep, ReplicaState.HEALTHY, reason)
+
+    @property
+    def live_replicas(self) -> list[EngineReplica]:
+        return [r for r in self.replicas if r.state is not ReplicaState.DEAD]
+
+    def _chaos_target(self, fault: str) -> EngineReplica:
+        """Resolve ``fleet_target_replica`` at FIRE time. With spawn/
+        retire the replica set is dynamic, so the bound cannot be judged
+        at config construction; a stale/unknown victim is a typed error,
+        never a silent no-op (a drill that skips its injection would
+        report a vacuous pass)."""
+        from dtc_tpu.resilience.errors import ChaosTargetError
+
+        tid = self.cfg.chaos.fleet_target_replica
+        rep = self.replicas[tid] if 0 <= tid < len(self.replicas) else None
+        if rep is None or rep.state is ReplicaState.DEAD:
+            raise ChaosTargetError(
+                f"chaos {fault}: fleet_target_replica {tid} is not a live "
+                f"replica at fire time (fleet size {len(self.replicas)}, "
+                f"live {[r.replica_id for r in self.live_replicas]})"
+            )
+        return rep
 
     # ------------------------------------------------------------------
     # health + failover

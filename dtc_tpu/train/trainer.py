@@ -371,7 +371,7 @@ def _train(
     el_on = el_cfg.enabled
     hosts = None
     if el_on:
-        from dtc_tpu.resilience.elastic import VirtualHosts, shrink_mesh
+        from dtc_tpu.resilience.elastic import VirtualHosts, resize_mesh
 
         if jax.process_count() > 1:
             raise ValueError(
@@ -401,7 +401,7 @@ def _train(
         for h in el_cfg.dead_hosts:
             hosts.kill(h)
         if el_cfg.dead_hosts:
-            mesh = shrink_mesh(mesh, hosts)
+            mesh = resize_mesh(mesh, hosts)
             num_devices = len(hosts.survivor_devices())
         if train_cfg.batch % int(mesh.shape["data"]) != 0:
             raise ValueError(
@@ -999,7 +999,7 @@ def _train(
             the host syncs below are the recovery's, not the loop's."""
             nonlocal state, data_it, mesh, train_step, num_devices
             nonlocal result_base, eval_fn, eval_set, snap_dispatch_cold
-            from dtc_tpu.resilience.elastic import shrink_mesh
+            from dtc_tpu.resilience.elastic import resize_mesh
             from dtc_tpu.resilience.errors import ElasticAbort
 
             # Goodput ledger (ISSUE 16): explicit detect/restored stamps
@@ -1007,7 +1007,11 @@ def _train(
             # a new sync in the hot loop.
             t_detect = time.time()
 
-            new_mesh = shrink_mesh(mesh, hosts)
+            # target_hosts=None -> the survivor set: the host-loss resize
+            # is the shrink direction of the general resize (the pool's
+            # GROW passes an explicit larger lease through the same
+            # function).
+            new_mesh = resize_mesh(mesh, hosts)
             new_data = int(new_mesh.shape["data"])
             if train_cfg.batch % new_data != 0:
                 raise ElasticAbort(

@@ -16,8 +16,9 @@ timeout -k 10 120 env JAX_PLATFORMS=cpu python -m pytest --collect-only -q -p no
   tests/test_analysis.py tests/test_numerics.py tests/test_bf16.py \
   tests/test_serve.py tests/test_trace.py tests/test_devprof.py \
   tests/test_adapters.py tests/test_overlap_collectives.py \
-  tests/test_router.py tests/test_elastic.py tests/test_goodput.py > /dev/null || {
-    echo "tier-1 pre-gate: MoE/HLO/decode/analysis/serve/trace/devprof/adapters/overlap/router/elastic/goodput test collection failed" >&2; exit 1; }
+  tests/test_router.py tests/test_elastic.py tests/test_goodput.py \
+  tests/test_pool.py > /dev/null || {
+    echo "tier-1 pre-gate: MoE/HLO/decode/analysis/serve/trace/devprof/adapters/overlap/router/elastic/goodput/pool test collection failed" >&2; exit 1; }
 # Pre-gate 2 (ISSUE 5 + 6): the graph audit — lower/compile the
 # dp/tp/fsdp/ep train steps (8-virtual-device CPU mesh), the greedy decode
 # scan, AND the serving (continuous-batching) decode step; run the rule
@@ -117,4 +118,18 @@ timeout -k 10 300 env JAX_PLATFORMS=cpu python scripts/elastic_smoke.py || {
 # must carry the goodput_pct counter track (ph "C"). ~1-2 min.
 timeout -k 10 300 env JAX_PLATFORMS=cpu python scripts/goodput_smoke.py || {
     echo "tier-1 pre-gate: goodput-ledger smoke failed" >&2; exit 1; }
+# Pre-gate 10 (ISSUE 17): resource-pool smoke — both legs of
+# scripts/pool_smoke.py. Diurnal: GROW absorbs every idle serve host
+# (zero-replica phase parks requests as typed backpressure), a spike
+# burst shrinks back; asserts the typed transition walk, zero silent
+# drops, loss parity vs an uninterrupted reference (prefix bit-exact,
+# suffix rtol<=1e-3), exactly ONE recompile per mesh change, and the
+# goodput gate (every resize billed as an elastic_resize incident,
+# train-shard unattributed <= 5%). Chaos leg: pool_spike_mid_grow
+# aborts the pre-resize grow cleanly and pool_kill_mid_shrink's victim
+# is never leased back, on the same assertions. ~2-3 min.
+timeout -k 10 480 env JAX_PLATFORMS=cpu python scripts/pool_smoke.py || {
+    echo "tier-1 pre-gate: pool smoke (diurnal) failed" >&2; exit 1; }
+timeout -k 10 480 env JAX_PLATFORMS=cpu python scripts/pool_smoke.py --chaos || {
+    echo "tier-1 pre-gate: pool smoke (chaos) failed" >&2; exit 1; }
 set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc

@@ -62,6 +62,9 @@ _QUICK_EXCLUDE_FILES = {
     # Drives the goodput chaos acceptance run: a NaN-rollback training
     # run plus a replica-kill fleet run in one test (ISSUE 16).
     "test_goodput.py",
+    # Drives pool grow/shrink resizes and a combined-chaos pool run
+    # (ISSUE 17).
+    "test_pool.py",
 }
 
 

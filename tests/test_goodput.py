@@ -297,6 +297,61 @@ def test_histogram_merge_empty_and_zero_bucket():
     assert h.count == 3 and h.max == 5.0
 
 
+def test_histogram_merge_mismatched_layout_is_typed_error():
+    """Satellite (ISSUE 17): merging histograms with different bucket
+    layouts is a typed HistogramLayoutError (a ValueError subclass) —
+    bucket indices are not comparable across growth factors, and a
+    silent merge would corrupt every percentile downstream."""
+    from dtc_tpu.obs import HistogramLayoutError
+
+    assert issubclass(HistogramLayoutError, ValueError)
+    a = Histogram("lat", bucket_growth=1.1)
+    b = Histogram("lat", bucket_growth=1.5)
+    a.observe(1.0)
+    b.observe(2.0)
+    with pytest.raises(HistogramLayoutError, match="bucket_growth"):
+        a.merge(b)
+    # The refused merge left the receiver untouched.
+    assert a.count == 1 and a.max == 1.0
+    # Layout is validated at construction too.
+    with pytest.raises(ValueError):
+        Histogram("lat", bucket_growth=1.0)
+
+
+def test_histogram_merge_order_never_changes_percentiles():
+    """Property (ISSUE 17): shard merge order is scheduler-determined
+    in reduce_shards — every permutation of the same shard set must
+    yield bit-identical count/total/min/max and percentiles."""
+    import itertools
+
+    rng = np.random.RandomState(11)
+    shards = [
+        rng.lognormal(mean=m, sigma=s, size=n).tolist()
+        for m, s, n in [(-2.0, 1.0, 80), (0.0, 0.3, 50), (-4.0, 2.0, 70)]
+    ]
+
+    def merged_in(order):
+        hs = []
+        for data in shards:
+            h = Histogram("x")
+            for v in data:
+                h.observe(v)
+            hs.append(h)
+        acc = hs[order[0]]
+        for i in order[1:]:
+            acc.merge(hs[i])
+        return acc
+
+    qs = (0.01, 0.25, 0.5, 0.9, 0.99)
+    ref = merged_in((0, 1, 2))
+    ref_pcts = [ref.percentile(q) for q in qs]
+    for order in itertools.permutations(range(3)):
+        m = merged_in(order)
+        assert m.count == ref.count and m.total == pytest.approx(ref.total)
+        assert m.min == ref.min and m.max == ref.max
+        assert [m.percentile(q) for q in qs] == ref_pcts, order
+
+
 # ---------------------------------------------------------------------------
 # Perfetto: counter track + aux_compile instant
 # ---------------------------------------------------------------------------

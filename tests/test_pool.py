@@ -1,0 +1,374 @@
+"""PoolManager tests (ISSUE 17): one chaos-verified resource manager
+over train + serve, with mesh GROW.
+
+What is pinned here:
+
+- The typed transition machine: every lease move walks
+  requested -> draining -> reassigned -> resized -> steady (or the one
+  extra edge -> aborted); an illegal edge is a RuntimeError, not a new
+  state.
+- GROW bit-honesty: a grow's restore is indistinguishable from a fresh
+  restart from the same snapshot on the same mesh — the next step's
+  loss is BIT-equal, not merely close.
+- HostMonitor roster transitions (satellite): deliberate surrender
+  (retire) is re-admittable; a host the monitor declared LOST is
+  refused by admit() forever — a grow must never resurrect a corpse —
+  and a re-admitted healthy host is still detectable when it dies.
+- The combined-chaos acceptance: spike-mid-grow aborts the pre-resize
+  grow cleanly, kill-mid-shrink lands on the shrink's bill, the killed
+  host is never leased back, every request ends in a typed terminal,
+  and recompiles == mesh changes exactly.
+
+The heavyweight diurnal/parity/goodput gates live in
+scripts/pool_smoke.py (verify_tier1 pre-gate); these tests stay at the
+unit/contract level so the suite runtime holds.
+"""
+
+import time
+import types
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from dtc_tpu.config.schema import (
+    AdapterConfig,
+    ChaosConfig,
+    ModelConfig,
+    PoolConfig,
+    RouterConfig,
+    ServeConfig,
+)
+from dtc_tpu.models.gpt import GPT
+from dtc_tpu.obs.registry import MetricsRegistry
+from dtc_tpu.pool import POOL_TRAIN_PROC, PoolManager, PoolTransition
+from dtc_tpu.pool.manager import _TRANSITION_EDGES, _TrainTenant
+from dtc_tpu.resilience.elastic import HostMonitor, VirtualHosts, resize_mesh
+from dtc_tpu.resilience.errors import ElasticAbort
+from dtc_tpu.serve import ReplicaState, Request
+
+VOCAB = 61
+
+
+def _model_and_params():
+    cfg = ModelConfig(
+        vocab_size=VOCAB, d_model=32, n_layers=2, n_heads=2, d_ff=64,
+        max_seq_len=32, dropout=0.0, param_dtype="float32",
+        compute_dtype="float32", attention="dense",
+        adapter=AdapterConfig(rank=0),
+    )
+    model = GPT(cfg)
+    params = model.init(
+        {"params": jax.random.PRNGKey(0)}, jnp.ones((1, 1), jnp.int32),
+        train=False,
+    )["params"]
+    return model, params, cfg
+
+
+@pytest.fixture(scope="module")
+def pool_model():
+    return _model_and_params()
+
+
+def _pool_cfg(**kw):
+    kw.setdefault("router", RouterConfig(
+        n_replicas=2,
+        serve=ServeConfig(
+            slots=2, page_size=8, queue_depth=8, max_new_tokens=4,
+            prefill_bucket=8,
+        ),
+    ))
+    kw.setdefault("n_hosts", 4)
+    kw.setdefault("train_hosts", 2)
+    kw.setdefault("min_serve_hosts", 0)
+    kw.setdefault("min_train_hosts", 1)
+    kw.setdefault("snapshot_every", 1)
+    kw.setdefault("grow_after_idle_ticks", 1)
+    return PoolConfig(**kw)
+
+
+# ---------------------------------------------------------------------------
+# the typed state machine
+# ---------------------------------------------------------------------------
+
+def test_transition_edge_table_is_closed():
+    """Every state is a key; terminal states have no exits; every exit
+    lands on a known state."""
+    states = set(_TRANSITION_EDGES)
+    assert states == {
+        "requested", "draining", "reassigned", "resized", "steady",
+        "aborted",
+    }
+    for src, dsts in _TRANSITION_EDGES.items():
+        assert dsts <= states
+    assert not _TRANSITION_EDGES["steady"]
+    assert not _TRANSITION_EDGES["aborted"]
+
+
+def _fake_pool():
+    """The minimal self for PoolManager._advance: a registry and a tick
+    counter — the edge validation itself is pure."""
+    return types.SimpleNamespace(reg=MetricsRegistry(99), _tick=0)
+
+
+def test_advance_walks_legal_edges_and_rejects_illegal_ones():
+    fake = _fake_pool()
+    tr = PoolTransition(kind="grow", hosts=[0], tick=0)
+    for state in ("draining", "reassigned", "resized", "steady"):
+        PoolManager._advance(fake, tr, state)
+        assert tr.state == state
+    assert tr.terminal
+
+    # Skipping a stage is a bug, not a transition.
+    tr2 = PoolTransition(kind="shrink", hosts=[1], tick=0)
+    with pytest.raises(RuntimeError, match="illegal pool transition"):
+        PoolManager._advance(fake, tr2, "resized")
+    # Terminal states have no exits — not even abort.
+    with pytest.raises(RuntimeError, match="illegal pool transition"):
+        PoolManager._advance(fake, tr, "aborted")
+    # Abort is reachable from every PRE-resize stage.
+    for pre in ("requested", "draining", "reassigned"):
+        t = PoolTransition(kind="grow", hosts=[2], tick=0, state=pre)
+        PoolManager._advance(fake, t, "aborted")
+        assert t.terminal
+    # ... but not from resized: past the mesh rebuild the transition
+    # must complete (a later shrink undoes it, the machine never
+    # half-rolls-back a live mesh).
+    t = PoolTransition(kind="grow", hosts=[3], tick=0, state="resized")
+    with pytest.raises(RuntimeError, match="illegal pool transition"):
+        PoolManager._advance(fake, t, "aborted")
+
+
+# ---------------------------------------------------------------------------
+# satellite: HostMonitor roster transitions
+# ---------------------------------------------------------------------------
+
+def test_monitor_kill_then_regrow_then_kill():
+    """Deliberate surrender is re-admittable; death is forever; a
+    re-admitted healthy host is still detectable when it later dies."""
+    hosts = VirtualHosts(4)
+    mon = HostMonitor(hosts, miss_limit=2)
+
+    # SHRINK: host 3 surrenders — leaves the beat table cleanly, is
+    # never declared lost, and a later admit is legal.
+    mon.retire(3)
+    mon.tick(1)
+    assert not mon.poll(1)
+    mon.tick(2)
+    assert not any(e["host"] == 3 for e in mon.poll(2))
+    mon.admit(3, step=2)
+
+    # Host 2 dies for real: misses miss_limit beats, declared lost once.
+    hosts.kill(2)
+    mon.tick(3)
+    mon.tick(4)
+    lost = [e for e in mon.poll(4) if e["kind"] == "host_lost"]
+    assert [e["host"] for e in lost] == [2]
+    assert mon.lost == {2}
+
+    # REGROW attempt: even after the emulation returns the capacity
+    # (revive), the monitor refuses to resurrect the corpse.
+    hosts.revive(2)
+    with pytest.raises(ElasticAbort, match="resurrect"):
+        mon.admit(2, step=5)
+
+    # The re-admitted host 3 is a first-class roster member again: kill
+    # it and detection fires exactly as for any monitored host.
+    hosts.kill(3)
+    mon.tick(5)
+    mon.tick(6)
+    lost = [e for e in mon.poll(6) if e["kind"] == "host_lost"]
+    assert [e["host"] for e in lost] == [3]
+    assert mon.lost == {2, 3}
+
+
+def test_monitor_retire_is_not_death():
+    hosts = VirtualHosts(4)
+    mon = HostMonitor(hosts, miss_limit=2)
+    mon.tick(1)
+    mon.retire(0)
+    # Many silent steps later the surrendered host is still not "lost".
+    for s in range(2, 8):
+        mon.tick(s)
+        assert not any(e["host"] == 0 for e in mon.poll(s))
+    assert 0 not in mon.lost
+
+
+# ---------------------------------------------------------------------------
+# GROW bit-honesty
+# ---------------------------------------------------------------------------
+
+def test_grow_restore_is_bit_identical_to_fresh_restart(pool_model):
+    """The tentpole's honesty gate: after a GROW, the next step's loss
+    is BIT-equal to a fresh restart from the same snapshot onto an
+    identically-built mesh — the grow path adds nothing and loses
+    nothing beyond the documented replay."""
+    from dtc_tpu.data.prefetch import split_put
+    from dtc_tpu.data.synthetic import synthetic_row_batches
+    from dtc_tpu.parallel.sharding import DEFAULT_RULES, batch_spec
+    from dtc_tpu.train.train_step import (
+        Batch,
+        canonicalize_state_placement,
+        create_train_step,
+    )
+
+    model, _, mcfg = pool_model
+    cfg = _pool_cfg(train_hosts=1, train_steps=12)
+    hosts = VirtualHosts(cfg.n_hosts)
+    reg = MetricsRegistry(POOL_TRAIN_PROC)
+    tenant = _TrainTenant(model, mcfg, cfg, hosts, {3}, reg, seed=0)
+    try:
+        for _ in range(5):
+            tenant.step_once()
+        info = tenant.resize({2, 3}, reason="pool_grow")
+        s = info["to_step"]
+        assert s == 5  # snapshot cadence 1: nothing to replay
+
+        # The fresh-restart oracle: same snapshot, same target mesh,
+        # same primitives — built independently of the tenant.
+        snap = tenant.snapshots.latest()
+        assert snap.step == s
+        mesh2 = resize_mesh(tenant.mesh, hosts, target_hosts={2, 3})
+        state2, _ = tenant.snapshots.restore(snap, hosts.alive, mesh2)
+        state2 = canonicalize_state_placement(state2, mesh2)
+        fn2 = create_train_step(mesh2, model=model, state=state2)
+        data2 = synthetic_row_batches(
+            cfg.global_batch, mcfg.max_seq_len + 1, VOCAB, seed=0,
+            start_row=s * cfg.global_batch,
+        )
+        x, y = split_put(next(data2), mesh2, batch_spec(DEFAULT_RULES))
+        with mesh2:
+            _, loss_oracle = fn2(
+                state2, Batch(x=x, y=y),
+                jax.random.fold_in(jax.random.PRNGKey(0), s + 1),
+            )
+        loss_oracle = float(jax.block_until_ready(loss_oracle))
+
+        loss_grow = tenant.step_once()
+        np.testing.assert_array_equal(
+            np.float32(loss_grow), np.float32(loss_oracle),
+            err_msg="grow restore diverged from a fresh restart "
+                    "from the same snapshot",
+        )
+        # Exactly one recompile for the mesh change — asserted, not
+        # excused (the tenant was steady before the resize).
+        assert tenant.recompiles == 1
+    finally:
+        tenant.close()
+        reg.close()
+
+
+# ---------------------------------------------------------------------------
+# combined-chaos acceptance (pool-level)
+# ---------------------------------------------------------------------------
+
+def test_pool_combined_chaos_typed_and_accounted(pool_model, tmp_path):
+    """pool_spike_mid_grow + pool_kill_mid_shrink on one run: the
+    pre-resize grow aborts cleanly, the kill lands on the shrink's
+    bill, the dead host is never leased back to either tenant, every
+    request (including the chaos burst) ends in a typed terminal, and
+    recompiles == completed mesh changes exactly."""
+    model, params, mcfg = pool_model
+    cfg = _pool_cfg(
+        train_steps=12,
+        chaos=ChaosConfig(
+            enabled=True,
+            # 6 requests over 2 accepting replicas crosses
+            # spike_queue_depth=3 — the spike must also trigger the
+            # shrink the kill lands in.
+            pool_spike_mid_grow_at=1, pool_spike_requests=6,
+            pool_kill_mid_shrink_at=1, elastic_target_host=1,
+        ),
+    )
+    pm = PoolManager(
+        model, params, mcfg, cfg, obs_dir=str(tmp_path), seed=0,
+    )
+    pm.submit(Request(rid="low0", prompt=[1, 2, 3], max_new_tokens=4))
+    ticks = 0
+    while pm.tick() and ticks < 400:
+        ticks += 1
+    assert ticks < 400, "pool never drained"
+    results = pm.close()
+
+    # Chaos landed where aimed.
+    aborted = [t for t in pm.transitions if t.state == "aborted"]
+    assert aborted and aborted[0].kind == "grow"
+    assert aborted[0].abort_reason == "load_spike"
+    killed = [t for t in pm.transitions if t.dead_hosts]
+    assert killed and killed[0].kind == "shrink"
+    # The corpse is nobody's lease, and stays that way.
+    assert 1 not in pm.serve_lease and 1 not in pm.train_lease
+    assert 1 not in pm.hosts.alive
+
+    # Every transition is in a legal state and walked only legal edges
+    # (a violation would have raised RuntimeError mid-run).
+    for t in pm.transitions:
+        assert t.state in _TRANSITION_EDGES
+
+    # Zero silent drops: 1 submitted + the chaos burst, all typed.
+    assert len(results) == 1 + cfg.chaos.pool_spike_requests
+    assert all(
+        r.state.value in ("done", "shed", "expired", "failed")
+        for r in results.values()
+    )
+
+    # Exactly one recompile per completed mesh change.
+    resizes = [t for t in pm.transitions if t.to_step is not None]
+    assert pm.trainer.recompiles == len(resizes)
+    # The training budget completed despite everything.
+    assert pm.trainer.cur_step == cfg.train_steps
+    assert len(pm.trainer.losses) == cfg.train_steps
+
+
+# ---------------------------------------------------------------------------
+# retire-drain walk on the router (pool GROW's draining stage)
+# ---------------------------------------------------------------------------
+
+def test_cancel_retire_restores_healthy(pool_model):
+    """A grow abort mid-drain walks DRAINING -> HEALTHY via
+    cancel_retire; cancel of a non-draining replica is a ValueError."""
+    from dtc_tpu.serve.router import FleetRouter
+
+    model, params, _ = pool_model
+    router = FleetRouter(model, params, RouterConfig(
+        n_replicas=2,
+        serve=ServeConfig(
+            slots=1, page_size=4, queue_depth=4, max_new_tokens=4,
+            prefill_bucket=8,
+        ),
+    ))
+    try:
+        rep = router.replicas[0]
+        with pytest.raises(ValueError):
+            router.cancel_retire(0)
+        router.begin_retire(0, reason="pool_grow")
+        assert rep.state is ReplicaState.DRAINING
+        assert not rep.accepting
+        router.cancel_retire(0, reason="pool_grow_abort")
+        assert rep.state is ReplicaState.HEALTHY
+        assert rep.accepting
+        # The restored replica serves again.
+        router.submit(Request(rid="a", prompt=[1, 2, 3], max_new_tokens=2))
+        for _ in range(50):
+            if router.results:
+                break
+            router.step()
+        assert router.results["a"].state.value == "done"
+    finally:
+        router.close()
+
+
+# ---------------------------------------------------------------------------
+# arbitration plumbing
+# ---------------------------------------------------------------------------
+
+def test_pool_config_validation():
+    with pytest.raises(ValueError):
+        _pool_cfg(min_train_hosts=0)
+    with pytest.raises(ValueError):
+        _pool_cfg(min_serve_hosts=1, train_hosts=4)  # breaks the serve floor
+    with pytest.raises(ValueError):
+        _pool_cfg(min_serve_hosts=-1)
+    _pool_cfg(min_serve_hosts=0)          # zero-replica full grow is legal

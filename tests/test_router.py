@@ -127,13 +127,49 @@ def test_router_config_validation():
         RouterConfig(placement="coin_flip")
     with pytest.raises(ValueError):
         RouterConfig(heartbeat_miss_limit=0)
-    # A chaos victim outside the fleet is a dead knob — rejected loudly.
-    with pytest.raises(ValueError, match="fleet_target_replica"):
-        RouterConfig(n_replicas=2, chaos=ChaosConfig(
-            enabled=True, fleet_kill_replica_at_step=3,
-            fleet_target_replica=5))
+    # ISSUE 17: a chaos victim beyond the CONSTRUCTION-time fleet size is
+    # legal config now — the replica set is dynamic (spawn/retire), so
+    # the bound is judged when the fault fires (see
+    # test_chaos_stale_target_is_typed_error_at_fire_time).
+    RouterConfig(n_replicas=2, chaos=ChaosConfig(
+        enabled=True, fleet_kill_replica_at_step=3, fleet_target_replica=5))
     RouterConfig(n_replicas=2, chaos=ChaosConfig(
         enabled=True, fleet_kill_replica_at_step=3, fleet_target_replica=1))
+
+
+def test_chaos_stale_target_is_typed_error_at_fire_time(fleet_model):
+    """Satellite (ISSUE 17): a fleet-chaos victim that does not exist at
+    FIRE time raises a typed ChaosTargetError — never a silent no-op or
+    a clamp onto some other replica — while a target only reachable via
+    a later spawn fires correctly."""
+    from dtc_tpu.resilience.errors import ChaosTargetError
+
+    model, params = fleet_model
+    # Stale target: replica 5 never exists in a 2-replica fleet.
+    router = FleetRouter(model, params, _rcfg(
+        n_replicas=2,
+        chaos=ChaosConfig(enabled=True, fleet_kill_replica_at_step=1,
+                          fleet_target_replica=5),
+    ))
+    router.submit(Request(rid="a", prompt=[1, 2, 3], max_new_tokens=4))
+    with pytest.raises(ChaosTargetError, match="fleet_target_replica 5"):
+        router.step()
+    router.close()
+
+    # The same victim id is LEGAL once a spawn has minted it: the drill
+    # fires on the spawned replica (construction would have rejected it
+    # under the old construction-time check).
+    router = FleetRouter(model, params, _rcfg(
+        n_replicas=2,
+        chaos=ChaosConfig(enabled=True, fleet_kill_replica_at_step=1,
+                          fleet_target_replica=2),
+    ), router_proc=64)
+    router.spawn_replica()
+    router.submit(Request(rid="b", prompt=[1, 2, 3], max_new_tokens=4))
+    router.run()
+    assert router.replicas[2].state is ReplicaState.DEAD
+    assert router.results["b"].state is RequestState.DONE
+    router.close()
 
 
 def test_fleet_chaos_config_validation():
