@@ -260,6 +260,106 @@ def decode_bench(
     }
 
 
+def spec_decode_bench(
+    spec_k: int = 2,
+    batch: int = 8,
+    prompt_len: int = 32,
+    new_tokens: int = 128,
+    draft_layers: int | None = None,
+    model_cfg=None,
+    model_label: str = "flagship",
+) -> dict:
+    """One speculative-decoding row (ISSUE 19): ``spec_generate`` on the
+    layer-fused megakernel backend — a resident ``draft_layers``-deep
+    rung of the target proposes ``spec_k - 1`` tokens per round, ONE
+    k-query verify launch accepts or rolls back. Scored on the
+    launch-economy metrics, not raw ms/token:
+
+    - ``ms_per_accepted_token`` — wall ms per EMITTED token (proposals
+      never enter the denominator; the A/B partner is a plain
+      ``decode_*`` row's ms_per_token at the same batch/backend);
+    - ``tokens_accepted_per_launch`` — mean emitted per verify launch,
+      in [1, spec_k]; the plain-decode equivalent is 1.0 by definition;
+    - ``accept_rate`` — draft proposals the verify kept.
+
+    Greedy acceptance only (the row is exactness-gated: fused_layers on
+    BOTH draft and verify — ``check_spec_backend``). ``draft_layers``
+    defaults to n_layers // 3 (the shallow-rung operating point).
+    Random params: launch economy is shape-dependent; accept_rate on
+    random weights is REAL but pessimistic (a trained target's layers
+    are more redundant), so the row's accept_rate is a floor, not the
+    deployment number."""
+    import time
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from dtc_tpu.config.schema import ModelConfig
+    from dtc_tpu.models.gpt import GPT
+    from dtc_tpu.spec import extract_draft, spec_generate
+    from dtc_tpu.utils.metrics import (
+        ms_per_accepted_token, tokens_accepted_per_launch,
+    )
+
+    model_cfg = model_cfg or ModelConfig(
+        **FLAGSHIP_DIMS, n_heads=16,
+        max_seq_len=512, dropout=0.0, param_dtype="float32",
+        compute_dtype="bfloat16", attention="auto",
+        decode_attention="fused_layers",
+    )
+    dl = draft_layers or max(1, model_cfg.n_layers // 3)
+    model = GPT(model_cfg)
+    x = jnp.ones((batch, 1), jnp.int32)
+    params = model.init({"params": jax.random.PRNGKey(0)}, x, train=False)["params"]
+    draft_model, draft_params = extract_draft(model, params, dl)
+    prompt = jax.random.randint(
+        jax.random.PRNGKey(1), (batch, prompt_len), 0, model_cfg.vocab_size,
+        jnp.int32,
+    )
+    run = lambda: spec_generate(  # noqa: E731
+        model, params, draft_model, draft_params, prompt, new_tokens,
+        spec_k=spec_k, return_stats=True,
+    )
+    out, stats = run()  # compile
+    np.asarray(out)
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        out, stats = run()
+        np.asarray(out)  # sync by value fetch (tunnel-safe)
+        best = min(best, time.perf_counter() - t0)
+    emitted = batch * new_tokens  # every row completes exactly new_tokens
+    launches = int(stats["rounds"])
+    rate = int(stats["accepted"]) / max(int(stats["proposed"]), 1)
+    mspa = ms_per_accepted_token(best, emitted)
+    # Per ROW per launch (one launch verifies the whole batch), so the
+    # number lands in [1, spec_k] and plain decode's equivalent is 1.0.
+    tapl = tokens_accepted_per_launch(emitted, launches * batch)
+    return {
+        "batch": batch,
+        "prompt_len": prompt_len,
+        "new_tokens": new_tokens,
+        "decode_attention": model_cfg.decode_attention,
+        "kv_cache_dtype": model_cfg.kv_cache_dtype,
+        "spec_k": spec_k,
+        "draft_layers": dl,
+        "spec_acceptance": "greedy",
+        "spec_model": model_label,
+        "platform": jax.devices()[0].platform,
+        "wall_s": round(best, 4),
+        "verify_launches": launches,
+        "accept_rate": round(rate, 4),
+        "tokens_accepted_per_launch": (
+            None if tapl is None else round(tapl, 3)
+        ),
+        "ms_per_accepted_token": (
+            None if mspa is None else round(mspa, 3)
+        ),
+        "tokens_per_sec": round(emitted / best, 1),
+    }
+
+
 from dtc_tpu.utils.percentile import nearest_rank as _pct  # noqa: E402
 # _pct: shared nearest-rank percentile (ISSUE 7 satellite) — one
 # definition for bench, scripts/trace_report.py, and the registry-
@@ -561,6 +661,8 @@ def serve_bench(
     max_wall_s: float = 600.0,
     n_tenants: int = 0,
     adapter_rank: int = 8,
+    spec_k: int = 0,
+    draft_layers: int = 0,
 ) -> dict:
     """One serving-scheduler row: Poisson arrivals at ``rps`` offered
     requests/s through the continuous-batching engine (dtc_tpu/serve/),
@@ -609,6 +711,17 @@ def serve_bench(
         {"params": jax.random.PRNGKey(0)}, jnp.ones((1, 1), jnp.int32),
         train=False,
     )["params"]
+    # Speculative serving leg (ISSUE 19): spec_k > 0 turns serve.spec on
+    # — the engine extracts the resident draft rung at construction and
+    # every decode iteration becomes one draft-propose + k-verify round.
+    # Exactness-gated by the engine itself (fused_layers backend, no
+    # adapters), so a misconfigured row errors instead of measuring a
+    # token-forking scheduler.
+    spec_kw = {}
+    if spec_k > 0:
+        from dtc_tpu.config.schema import SpecConfig
+
+        spec_kw["spec"] = SpecConfig(spec_k=spec_k, draft_layers=draft_layers)
     scfg = ServeConfig(
         slots=slots,
         page_size=16,
@@ -618,6 +731,7 @@ def serve_bench(
         shed_watermark=shed_watermark,
         deadline_s=deadline_s,
         max_adapters=max(n_tenants + 1, 2),
+        **spec_kw,
     )
     eng = ServingEngine(model, params, scfg)
     tenant_names: list = [None]
@@ -682,7 +796,23 @@ def serve_bench(
     # ~10% bucket of exact nearest-rank (parity-tested in test_trace).
     q = lambda name, p: eng.reg.histogram(name).percentile(p)  # noqa: E731
     r4 = lambda v: None if v is None else round(v, 4)  # noqa: E731
+    # Speculative acceptance aggregates (spec rows only): accepted-token
+    # throughput IS sustained_tokens_per_sec (every delivered token was
+    # accepted — the exactness gate), so the extra numbers are the
+    # acceptance economics behind it.
+    spec_fields: dict = {
+        "spec_k": spec_k,
+        "draft_layers": draft_layers if spec_k > 0 else 0,
+        "spec_acceptance": "greedy" if spec_k > 0 else "off",
+    }
+    if spec_k > 0:
+        prop = sum(r.n_spec_proposed for r in res)
+        acc = sum(r.n_spec_accepted for r in res)
+        spec_fields["spec_accept_rate"] = (
+            round(acc / prop, 4) if prop else None
+        )
     return {
+        **spec_fields,
         "rps": None if rps is None else round(rps, 3),
         "offered_tokens_per_sec": (
             None if rps is None else round(rps * max_new_tokens, 1)
@@ -775,6 +905,34 @@ def serve_int8_row(emit, serve_cfg_kw: dict, *, seed: int = 0) -> None:
          lambda: serve_bench(
              None, seed=seed, queue_depth=kw.get("n_requests", 32),
              shed_watermark=0.0, **kw)))
+
+
+def serve_spec_row(
+    emit, serve_cfg_kw: dict, *, seed: int = 0, spec_k: int = 4,
+    draft_layers: int | None = None,
+) -> None:
+    """The ISSUE 19 serving row: one closed-loop capacity measurement
+    with ``serve.spec`` ON (layer-fused backend — the exactness gate's
+    requirement — and the draft rung resident). A/B against
+    ``serve_cal_closed_loop`` (same arrival shape, spec off) reads
+    speculation's scheduler-level value: the delta in sustained
+    tokens/s is pure launch economy, because the emitted tokens are
+    token-identical by construction. The ``*_spec`` serve_model label +
+    the spec config fields keep the drift guard comparing like to
+    like."""
+    import dataclasses
+
+    kw = dict(serve_cfg_kw)
+    base_cfg = kw.pop("model_cfg", None) or flagship_model_cfg(dropout=0.0)
+    kw["model_cfg"] = dataclasses.replace(
+        base_cfg, decode_attention="fused_layers", dropout=0.0
+    )
+    dl = draft_layers or max(1, base_cfg.n_layers // 3)
+    kw["model_label"] = kw.get("model_label", "flagship") + "_spec"
+    emit("serve_spec_closed_loop", _safe("serve_spec_closed_loop",
+         lambda: serve_bench(
+             None, seed=seed, queue_depth=kw.get("n_requests", 32),
+             shed_watermark=0.0, spec_k=spec_k, draft_layers=dl, **kw)))
 
 
 def serve_lora_rows(
@@ -1189,9 +1347,32 @@ def decode_drift_guard(extra: dict, repo_dir: str | None = None) -> list[str]:
     # all match — an overlapped row must never be judged against an xla
     # row, nor a multi-chip row against a 1-chip one.
     def decode_cfg(r):
-        return (r.get("decode_attention", "fused"), r.get("kv_cache_dtype", "auto"))
+        # Spec keys (ISSUE 19) ride the same rule: a speculative row must
+        # never be judged against a plain one (their ms/token means
+        # different things — accepted vs sequential tokens). Pre-ISSUE-19
+        # rows lack the fields and were all spec-off — normalize, same
+        # pattern as the ISSUE-11 kv_cache_dtype default above.
+        return (
+            r.get("decode_attention", "fused"),
+            r.get("kv_cache_dtype", "auto"),
+            r.get("spec_k", 0),
+            r.get("draft_layers", 0),
+            r.get("spec_acceptance", "off"),
+        )
 
     compare("decode", "ms_per_token", lambda o, r: decode_cfg(o) == decode_cfg(r))
+    # Speculative rows (ISSUE 19, labels spec_*): guarded on
+    # ms-per-ACCEPTED-token — the launch-economy metric a spec row is
+    # scored by (raw ms/token would reward rejected work) — under the
+    # decode rule, whose spec keys keep k2 vs k4 vs plain apart.
+    compare("spec", "ms_per_accepted_token", lambda o, r: (
+        decode_cfg(o) == decode_cfg(r)
+        # A CPU-measured spec row (tiny model, tunnel-outage artifact)
+        # must never be judged against a TPU flagship one — the same
+        # platform/model rule the serve family carries.
+        and o.get("platform") == r.get("platform")
+        and o.get("spec_model") == r.get("spec_model")
+    ))
     # Fleet rows (serve_fleet_*, ISSUE 13) ride the serve family via the
     # shared "serve" prefix; their extra same-config requirement is the
     # replica count (absent on both sides for single-engine rows) — a
@@ -1340,6 +1521,13 @@ def main(argv: list[str] | None = None) -> None:
         "transition/recompile counts)",
     )
     ap.add_argument(
+        "--spec-only", action="store_true",
+        help="run ONLY the speculative-decoding rows (ISSUE 19 — the "
+        "spec_b8_k{2,4} launch-economy rows + the serve_spec closed-loop "
+        "capacity row and its spec-off calibration partner; the "
+        "CPU-measured artifact path while the TPU tunnel is down)",
+    )
+    ap.add_argument(
         "--devprof-only", action="store_true",
         help="run ONLY the device-time attribution row + trace overhead "
         "(ISSUE 8 — the CPU-measured observatory artifact path while the "
@@ -1374,6 +1562,50 @@ def main(argv: list[str] | None = None) -> None:
         )
     else:
         serve_cfg_kw = dict(model_cfg=None, model_label="flagship")
+
+    if args.spec_only:
+        # The spec_* rows on the chosen model (tiny fits the 1-core CPU
+        # host in minutes; flagship is the TPU row set). Tiny shapes
+        # respect the audit model's max_seq_len=32 headroom
+        # (prompt + new + spec_k - 1 <= 32).
+        if args.serve_model == "tiny":
+            from dtc_tpu.analysis.lowering import audit_model_cfg
+
+            spec_gen_kw = dict(
+                model_cfg=audit_model_cfg(decode_attention="fused_layers"),
+                model_label="tiny", prompt_len=8, new_tokens=16,
+                draft_layers=2,
+            )
+        else:
+            spec_gen_kw = dict()
+        for k in (2, 4):
+            emit(f"spec_b8_k{k}", _safe(f"spec_b8_k{k}",
+                 lambda k=k: spec_decode_bench(spec_k=k, **spec_gen_kw)))
+        # The closed-loop A/B pair: spec-off calibration + spec-on row,
+        # same arrival shape — the delta IS the launch economy.
+        cal_label = "serve_cal_closed_loop"
+        n_req = serve_cfg_kw.get("n_requests", 32)
+        emit(cal_label, _safe(cal_label, lambda: serve_bench(
+            None, seed=args.serve_seed, queue_depth=n_req,
+            shed_watermark=0.0, **serve_cfg_kw)))
+        serve_spec_row(emit, serve_cfg_kw, seed=args.serve_seed)
+        extra = {
+            "devices": jax.device_count(),
+            "device_kind": jax.devices()[0].device_kind,
+            "serve_model": args.serve_model,
+        }
+        for ev in sink.events:
+            if ev["etype"] != "bench_config":
+                continue
+            extra[ev["label"]] = {
+                k: v for k, v in ev.items()
+                if k not in ("etype", "ts", "proc", "label")
+            }
+        for flag in decode_drift_guard(extra):
+            print(f"# DECODE REGRESSION: {flag}")
+        print("# bench-detail:", json.dumps(extra))
+        reg.close()
+        return
 
     if args.devprof_only:
         emit("devprof_b8", _safe("devprof_b8", devprof_bench))
@@ -1436,6 +1668,9 @@ def main(argv: list[str] | None = None) -> None:
         serve_bench_rows(emit, seed=args.serve_seed, **serve_cfg_kw)
         serve_lora_rows(emit, seed=args.serve_seed, **serve_cfg_kw)
         serve_int8_row(emit, serve_cfg_kw, seed=args.serve_seed)
+        # Speculative serving row (ISSUE 19): closed-loop capacity with
+        # serve.spec ON — A/B partner of serve_cal_closed_loop.
+        serve_spec_row(emit, serve_cfg_kw, seed=args.serve_seed)
         # Fleet rows (ISSUE 13): router over 3 in-process replicas —
         # calibration, 0.9x/3x offered load, replica-kill chaos leg.
         serve_fleet_rows(emit, seed=args.serve_seed, **serve_cfg_kw)
@@ -1543,6 +1778,14 @@ def main(argv: list[str] | None = None) -> None:
          lambda: decode_bench(decode_attention="fused_layers")))
     emit("decode_b8_int8", _safe("decode_b8_int8", lambda: decode_bench(
         decode_attention="fused_layers", kv_cache_dtype="int8")))
+    # ISSUE 19 rows: speculative decoding on the megakernel — scored on
+    # ms per ACCEPTED token and tokens/launch (the A/B partner is
+    # decode_b8_fused_layers' ms_per_token; a draft earns its keep when
+    # ms_per_accepted_token comes in under it).
+    emit("spec_b8_k2", _safe("spec_b8_k2",
+         lambda: spec_decode_bench(spec_k=2)))
+    emit("spec_b8_k4", _safe("spec_b8_k4",
+         lambda: spec_decode_bench(spec_k=4)))
     # Serving-scheduler rows (ISSUE 6): Poisson arrivals through the
     # continuous-batching engine at calibrated offered loads, including
     # one past saturation — the row that shows shedding holds p99.
@@ -1550,6 +1793,9 @@ def main(argv: list[str] | None = None) -> None:
     # int8-KV serving row (ISSUE 11): the closed-loop capacity shape on
     # the megakernel + int8 cache — see serve_int8_row.
     serve_int8_row(emit, serve_cfg_kw, seed=args.serve_seed)
+    # Speculative serving row (ISSUE 19): closed-loop capacity with
+    # serve.spec ON — A/B partner of serve_cal_closed_loop.
+    serve_spec_row(emit, serve_cfg_kw, seed=args.serve_seed)
     # Multi-tenant LoRA rows (ISSUE 10): N tenants on one resident base;
     # the delta vs the serve_* rows is the per-token multi-tenant price.
     serve_lora_rows(emit, seed=args.serve_seed, **serve_cfg_kw)

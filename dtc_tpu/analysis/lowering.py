@@ -696,6 +696,127 @@ def build_serve_artifact(
     )
 
 
+def build_spec_serve_artifact(*, execute: bool = True) -> Artifact:
+    """Lower + compile the SPECULATIVE serving round (ISSUE 19) — the
+    jitted draft-propose + single k-verify + accept + rollback program
+    (:func:`dtc_tpu.spec.serve_round`) the engine drives when
+    ``serve.spec`` is on, over the engine's fixed slot-batch shapes
+    (``decode_attention: fused_layers``, the one backend that keeps the
+    k-verify greedy token-identical — ``check_spec_backend``).
+
+    Its recompile fingerprint extends the compiled-shape invariant to
+    the speculative path: between the two measured round executions a
+    request is ADMITTED (target prefill + draft-rung prefill + both
+    cache inserts), taking the in-flight batch from one slot to two —
+    and the round, whose batch is the FIXED slot shape with idle slots
+    frozen by ``remaining == 0``, must reuse the ONE executable
+    (cold==1, steady==0). The draft rung itself is extracted at engine
+    construction (zero-copy layer slice, embed/head shared by
+    reference), so "loading the draft" is free of per-request compiles
+    by construction; admission is the churn this entry audits."""
+    from dtc_tpu.config.schema import ServeConfig, SpecConfig
+    from dtc_tpu.serve.engine import ServingEngine
+    from dtc_tpu.serve.request import Request
+    from dtc_tpu.spec import serve_round
+
+    model_cfg = audit_model_cfg(decode_attention="fused_layers")
+    model = GPT(model_cfg)
+    params = jax.jit(
+        lambda r, x: model.init({"params": r, "dropout": r}, x, train=False)
+    )(jax.random.PRNGKey(0), jnp.ones((1, model_cfg.max_seq_len), jnp.int32))[
+        "params"
+    ]
+    spec_cfg = SpecConfig(spec_k=2, draft_layers=3)
+    scfg = ServeConfig(slots=2, page_size=8, queue_depth=8, max_new_tokens=4,
+                       prefill_bucket=8, spec=spec_cfg)
+    eng = ServingEngine(model, params, scfg)
+    toks = jnp.zeros((scfg.slots, 1), jnp.int32)
+    rem = jnp.zeros((scfg.slots,), jnp.int32)
+    args = (
+        model, eng.draft_model, spec_cfg.spec_k, params, eng.draft_params,
+        eng.cache, eng.draft_cache, toks, rem,
+    )
+    lowered = serve_round.lower(*args)
+    stablehlo = lowered.as_text()
+    compiled = lowered.compile()
+    hlo = compiled.as_text()
+    traced = serve_round.trace(*args)
+    weak = sum(
+        1 for v in traced.jaxpr.jaxpr.outvars
+        if getattr(v.aval, "weak_type", False)
+    )
+    serve_state_bytes = {
+        "params": sum(_local_nbytes(p) for p in jax.tree.leaves(params)),
+        "cache": sum(_local_nbytes(c) for c in jax.tree.leaves(eng.cache)),
+        # The resident rung's KV — the HBM cost speculation actually
+        # adds (draft WEIGHTS are zero-copy views of the target's).
+        "draft_cache": sum(
+            _local_nbytes(c) for c in jax.tree.leaves(eng.draft_cache)
+        ),
+        # The rung's weights ARE entry parameters of the round's module
+        # (the memory audit reconciles against those), even though
+        # host-side they alias the target's buffers — counted here so
+        # the decomposition reproduces the program, with the aliasing
+        # recorded in the entry's own docs (PERF.md ISSUE-19 round).
+        "draft_params": sum(
+            _local_nbytes(p) for p in jax.tree.leaves(eng.draft_params)
+        ),
+    }
+    cold = steady = None
+    if execute:
+        # Warm every helper an admission runs — target prefill, draft
+        # prefill, both cache inserts, and the release path (the warm
+        # request finishes at prefill: max_new_tokens=1 never enters a
+        # spec round) — so the measured window isolates the round.
+        eng.submit(Request(rid="warm", prompt=[1, 2, 3], max_new_tokens=1))
+        eng.run(max_steps=8)
+        # The round's only other dispatch is the host->device transfer of
+        # the (slots,) int32 last-token / remaining vectors — a one-off
+        # broadcast_in_dim the prefill-only warm request never reaches.
+        jax.block_until_ready(
+            jnp.asarray(np.zeros((scfg.slots,), np.int32))[:, None]
+        )
+
+        def call_once():
+            eng.submit(Request(rid="a", prompt=[1, 2, 3], max_new_tokens=4))
+            eng.step()  # admits "a", runs a round — the ONE compile
+            return eng.cache
+
+        def call_again(_):
+            eng.submit(Request(rid="b", prompt=[4, 5], max_new_tokens=4))
+            eng.step()  # admits "b": in-flight batch 1 -> 2, same round
+            return eng.cache
+
+        cold, steady = _measure_compiles(call_once, call_again)
+    return Artifact(
+        name="serve_spec",
+        kind="serve",
+        parallel=None,
+        mesh_shape={},
+        batch=scfg.slots,
+        seq_len=model_cfg.max_seq_len,
+        hlo_text=hlo,
+        stablehlo_text=stablehlo,
+        expected_donated=0,
+        param_shapes=_param_shapes(params),
+        weak_outputs=weak,
+        n_layers=model_cfg.n_layers,
+        moe_experts=0,
+        compute_dtype=model_cfg.compute_dtype,
+        cold_compiles=cold,
+        steady_compiles=steady,
+        comm_estimate=None,
+        state_bytes=serve_state_bytes,
+        state_dtypes={
+            "params": sorted({
+                _hlo_dtype(p) for p in jax.tree.leaves(params)
+            }),
+        },
+        batch_bytes=_local_nbytes(toks) + _local_nbytes(rem),
+        mem_stats=_compiled_mem_stats(compiled),
+    )
+
+
 def build_artifacts(
     modes: Sequence[str], *, decode: bool = False, serve: bool = False,
     execute: bool = True
@@ -714,11 +835,13 @@ def build_artifacts(
         )
     if serve:
         # All serving flavors: the multi-tenant (lora) step, the
-        # adapter-free step, AND the int8+megakernel step — distinct
-        # compiled programs, each with its own committed baseline.
+        # adapter-free step, the int8+megakernel step, AND the
+        # speculative round (ISSUE 19) — distinct compiled programs,
+        # each with its own committed baseline.
         arts.append(build_serve_artifact(execute=execute, lora=True))
         arts.append(build_serve_artifact(execute=execute, lora=False))
         arts.append(
             build_serve_artifact(execute=execute, lora=True, kv_int8=True)
         )
+        arts.append(build_spec_serve_artifact(execute=execute))
     return arts

@@ -472,7 +472,11 @@ class SloConfig:
     ``goodput_min_pct`` — a FLOOR objective (ISSUE 16): the window mean
     of the online ``goodput_pct`` gauge must stay >= the threshold, so
     the breach direction is inverted relative to the latency
-    objectives."""
+    objectives. Serving additionally accepts
+    ``accepted_tokens_per_s_min`` (ISSUE 19) — a floor on ACCEPTED-token
+    throughput, so a speculative engine whose proposals stop landing
+    breaches (and degrades admissions) even while raw launch counts look
+    healthy: the watermark prices accepted tokens, never proposals."""
 
     enabled: bool = True
     window: int = 64        # samples per objective's sliding window
@@ -483,6 +487,9 @@ class SloConfig:
     ms_per_token_p99: float = 0.0
     queue_wait_p99_s: float = 0.0
     shed_rate: float = 0.0
+    # Floor on accepted-token throughput (tokens/s; 0 = off) — the
+    # speculative engine's honesty objective (ISSUE 19).
+    accepted_tokens_per_s_min: float = 0.0
     # -- training objectives (seconds; 0 = off) --
     step_time_p99_s: float = 0.0
     data_wait_p99_s: float = 0.0
@@ -497,7 +504,8 @@ class SloConfig:
         if self.check_every < 1:
             raise ValueError("slo check_every must be >= 1")
         for f in ("ttft_p99_s", "ms_per_token_p99", "queue_wait_p99_s",
-                  "step_time_p99_s", "data_wait_p99_s"):
+                  "step_time_p99_s", "data_wait_p99_s",
+                  "accepted_tokens_per_s_min"):
             if getattr(self, f) < 0:
                 raise ValueError(f"slo {f} must be >= 0 (0 = off)")
         if not 0.0 <= self.shed_rate <= 1.0:
@@ -802,6 +810,51 @@ class ResilienceConfig:
 
 
 @dataclass(frozen=True)
+class SpecConfig:
+    """Speculative decoding (``dtc_tpu/spec/``, ISSUE 19): a resident
+    truncated-layer draft proposes, the target verifies k positions in
+    ONE megakernel launch, and acceptance gates every emitted token —
+    greedy serving output is token-identical to plain decode. Off by
+    default (``spec_k = 0``)."""
+
+    #: Verify-window width: query positions per verify launch (the draft
+    #: proposes ``spec_k - 1`` tokens per round). 0 = speculation off;
+    #: otherwise 2..8 (ops/decode_fused._SPEC_MAX_K).
+    spec_k: int = 0
+    #: Draft depth: bottom layers of the TARGET checkpoint the draft
+    #: rung reuses (spec/draft.py). Must be >= 1 and strictly less than
+    #: the model's n_layers (validated at engine construction, where the
+    #: model is known).
+    draft_layers: int = 0
+    #: Acceptance rule: "greedy" (token-identity vs the target's argmax —
+    #: the serving engine's mode; its decode IS greedy) or "sampled"
+    #: (rejection sampling, generate()-only — the engine rejects it).
+    acceptance: str = "greedy"
+
+    def __post_init__(self) -> None:
+        if self.spec_k != 0 and not 2 <= self.spec_k <= 8:
+            raise ValueError(
+                f"spec_k must be 0 (off) or in [2, 8], got {self.spec_k}"
+            )
+        if self.spec_k > 0 and self.draft_layers < 1:
+            raise ValueError(
+                "draft_layers must be >= 1 when speculation is on "
+                f"(spec_k={self.spec_k})"
+            )
+        if self.draft_layers < 0:
+            raise ValueError("draft_layers must be >= 0")
+        if self.acceptance not in ("greedy", "sampled"):
+            raise ValueError(
+                f"unknown spec acceptance {self.acceptance!r}; expected "
+                "'greedy' or 'sampled'"
+            )
+
+    @property
+    def enabled(self) -> bool:
+        return self.spec_k >= 2
+
+
+@dataclass(frozen=True)
 class ServeConfig:
     """Serving-runtime configuration (``dtc_tpu/serve/``): continuous
     batching over a paged KV cache with admission control, deadlines, and
@@ -885,6 +938,11 @@ class ServeConfig:
     # scheduler iterations; a breaching latency objective activates the
     # graceful-degradation cap exactly like crossing degrade_watermark.
     slo: SloConfig = field(default_factory=SloConfig)
+    # Speculative decoding (dtc_tpu/spec/, ISSUE 19): draft-propose +
+    # one-launch k-verify per scheduler iteration. Greedy output stays
+    # token-identical to spec-off serving; throughput knobs (admission,
+    # shed, SLO) price ACCEPTED tokens, never proposals.
+    spec: SpecConfig = field(default_factory=SpecConfig)
 
     def __post_init__(self) -> None:
         if self.slots < 1:
@@ -933,6 +991,12 @@ class ServeConfig:
                 "would otherwise never be detected and the damaged request "
                 "would complete with wrong tokens (use 1 for the bit-exact "
                 "no-tainted-tokens guarantee)"
+            )
+        if self.spec.enabled and self.spec.acceptance != "greedy":
+            raise ValueError(
+                "serving speculation supports acceptance='greedy' only "
+                "(the engine's decode IS greedy argmax); 'sampled' "
+                "rejection acceptance is the generate()/spec_generate path"
             )
 
 

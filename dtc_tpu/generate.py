@@ -48,7 +48,7 @@ def init_cache(model, batch_size: int) -> PyTree:
 
 
 def decode_step(model, params: PyTree, cache: PyTree, tok: jax.Array,
-                lora: PyTree | None = None):
+                lora: PyTree | None = None, spec_verify: bool = False):
     """ONE decode iteration: apply the model to ``tok`` (B, T_new) with the
     KV cache threaded through, returning ``(new_cache, logits)`` with
     logits ``(B, T_new, V)``.
@@ -75,10 +75,19 @@ def decode_step(model, params: PyTree, cache: PyTree, tok: jax.Array,
     O(layers)·O(ops)); prefill and unsupported shapes fall back to the
     per-layer model apply below. Because BOTH drivers route here, the
     megakernel serves generate's scalar frontier and the engine's (B,)
-    slot frontiers from the same code path."""
+    slot frontiers from the same code path.
+
+    ``spec_verify=True`` marks a speculative k-token VERIFY call (ISSUE
+    19): ``tok`` is (B, k) draft proposals at the frontier, and the
+    megakernel — not the prefill fallback — takes all k query positions
+    in ONE launch (causal among the k in-register, cache writes at
+    ``frontier..frontier+k-1``). The flag only widens the fused_layers
+    gate; the per-layer model apply below already handles multi-token
+    frontier appends (the same path prefill uses), so the xla/fused
+    fallback ladder IS the verify parity oracle."""
     from dtc_tpu.ops import decode_fused
 
-    if decode_fused.use_fused_layers(model.cfg, tok.shape[1]):
+    if decode_fused.use_fused_layers(model.cfg, tok.shape[1], verify=spec_verify):
         return decode_fused.fused_decode_step(model, params, cache, tok, lora)
     variables = {"params": params, "cache": cache}
     if lora is not None:

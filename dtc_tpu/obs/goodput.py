@@ -77,13 +77,18 @@ ELASTIC_RESIZE = "elastic_resize"
 FAILOVER_REPLAY = "failover_replay"
 SHED_OR_IDLE = "shed_or_idle"
 DEGRADED = "degraded"
+#: ISSUE 19: verify work spent on draft proposals the target REJECTED —
+#: speculation's structural price. Typed badput, never productive:
+#: a speculative engine's goodput % cannot be inflated by proposing
+#: wildly and accepting little (the acceptance rate shows up HERE).
+SPEC_REJECTED_DRAFT = "spec_rejected_draft"
 UNATTRIBUTED = "unattributed"
 
 #: The closed taxonomy — every classified second belongs to exactly one.
 CLASSES = (
     PRODUCTIVE_TRAIN, PRODUCTIVE_DECODE, PREFILL, DATA_WAIT, COMPILE,
     SNAPSHOT_COMMIT, ROLLBACK_REPLAY, ELASTIC_RESIZE, FAILOVER_REPLAY,
-    SHED_OR_IDLE, DEGRADED, UNATTRIBUTED,
+    SHED_OR_IDLE, DEGRADED, SPEC_REJECTED_DRAFT, UNATTRIBUTED,
 )
 
 #: Classes that count toward goodput %. Prefill is productive: those
@@ -221,7 +226,7 @@ class HostLedger:
 #: Span names consumed as intervals. Step/phase/compile spans are
 #: SKIPPED — the ``step``/``compile`` events carry the same seconds and
 #: exist even with tracing off; consuming both would double-count.
-_SERVE_SPANS = ("decode_step", "req.prefill")
+_SERVE_SPANS = ("decode_step", "req.prefill", "spec_reject")
 #: ``snapshot_dispatch`` (PR 17) is the synchronous half of an async
 #: in-memory snapshot: device copies dispatched on the hot loop before
 #: the commit thread takes over — snapshot wall, same class.
@@ -457,6 +462,15 @@ class GoodputLedger:
                     serveish = True
                     raw.append(self._prefill_interval(
                         str(e.get("rid") or e.get("tid")), t0, t0 + d,
+                    ))
+                elif name == "spec_reject":
+                    # ISSUE 19: the rejected-proposal share of a
+                    # speculative round — the engine splits each round's
+                    # wall by accepted fraction and emits the remainder
+                    # here. Typed badput by construction.
+                    serveish = True
+                    raw.append(Interval(
+                        t0, t0 + d, SPEC_REJECTED_DRAFT, cause="spec_reject",
                     ))
                 elif name in _COMMIT_SPANS:
                     raw.append(Interval(

@@ -48,6 +48,10 @@ _SERVE_OBJECTIVES = {
     "queue_wait_p99_s": ("serve_queue_wait_s", "quantile"),
     "shed_rate": ("serve_outcome_shed", "rate"),
     "goodput_min_pct": ("goodput_pct", "floor"),
+    # ISSUE 19: floor on ACCEPTED-token throughput — the speculative
+    # engine samples its sliding accepted-tokens/s here every SLO check,
+    # so shed/degrade honesty keys off tokens that landed, not proposals.
+    "accepted_tokens_per_s_min": ("serve_accepted_tokens_per_s", "floor"),
 }
 _TRAIN_OBJECTIVES = {
     "step_time_p99_s": ("step_time_s", "quantile"),
@@ -156,9 +160,14 @@ class SloMonitor:
 
     @property
     def degrade_active(self) -> bool:
-        """True while any latency (quantile) objective is breaching —
-        the hook the serving scheduler's graceful-degradation policy
-        consults at admission."""
+        """True while any latency (quantile) objective — or the
+        accepted-token throughput floor (ISSUE 19) — is breaching: the
+        hook the serving scheduler's graceful-degradation policy
+        consults at admission. A speculative engine whose accepted
+        throughput collapses degrades new admissions exactly like a
+        latency breach, so speculation cannot hide behind launch counts."""
         return any(
-            rec["kind"] == "quantile" for rec in self.active.values()
+            rec["kind"] == "quantile"
+            or rec["objective"] == "accepted_tokens_per_s_min"
+            for rec in self.active.values()
         )

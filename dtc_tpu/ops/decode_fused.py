@@ -83,6 +83,14 @@ _DTYPES = {
 #: row) grid step — the same single-tile bound as the per-layer kernel.
 _FUSED_LAYERS_MAX_S = 4096
 
+#: Widest speculative verify window the megakernel serves as one launch
+#: (t query positions against the frontier, causal among themselves
+#: in-register). Tiny by design: speculation past ~8 proposals is
+#: acceptance-rate-limited, not launch-limited, and a small static bound
+#: keeps the (t, S) score tile inside the same VMEM envelope the
+#: single-query kernel already budgets.
+_SPEC_MAX_K = 8
+
 #: Per-grid-step VMEM working-set budget: one layer's weights (param
 #: dtype) + one row's K/V cache tile (+ scales) must fit under this for
 #: the kernel to be schedulable. ~16 MB/core on v5e; 14 MB leaves
@@ -129,12 +137,17 @@ def supports_fused_layers(cfg) -> bool:
     return weights + row <= _VMEM_BUDGET_BYTES
 
 
-def use_fused_layers(cfg, t_new: int) -> bool:
-    """The decode_step routing predicate: knob on, single-token call,
-    supported shape."""
+def use_fused_layers(cfg, t_new: int, verify: bool = False) -> bool:
+    """The decode_step routing predicate: knob on, single-token call (or
+    a ``verify`` call of up to ``_SPEC_MAX_K`` query positions — the
+    speculative k-token verify, ISSUE 19), supported shape. Prefill
+    (multi-token WITHOUT ``verify``) keeps falling back to the per-layer
+    path: a prompt pass is compute-bound and belongs to XLA's fusions,
+    while a verify window is the same frontier-append regime as decode."""
+    ok_t = t_new == 1 or (verify and 2 <= t_new <= _SPEC_MAX_K)
     return (
         getattr(cfg, "decode_attention", None) == "fused_layers"
-        and t_new == 1
+        and ok_t
         and supports_fused_layers(cfg)
     )
 
@@ -146,10 +159,17 @@ def use_fused_layers(cfg, t_new: int) -> bool:
 
 def _fused_layers_kernel(
     *refs,
-    h, d, s, dm, quant, per_row, lora_sites, lora_per_row, lora_scale,
+    h, d, s, t, dm, quant, per_row, lora_sites, lora_per_row, lora_scale,
     cdtype, kv_dtype,
 ):
     """One (layer, batch-row) grid step of the fused decode block.
+
+    ``t`` is the number of in-register query positions: 1 for plain
+    decode, or the speculative verify window (ISSUE 19) — the ``t``
+    tokens all sit at the frontier (positions ``start .. start+t-1``),
+    attend to cache columns ``< start`` plus each other causally
+    in-register, and their k/v land in the ``(.., t, ..)`` frontier
+    updates the caller scatters in one slice.
 
     ``refs`` order (inputs, then outputs, then scratch — the pallas_call
     contract): frontier (SMEM), x, 16 weight blocks (ln1 s/b, q/k/v/out
@@ -179,9 +199,9 @@ def _fused_layers_kernel(
 
     @pl.when(l == 0)
     def _():
-        x_scr[pl.ds(b, 1), :] = x_ref[0]
+        x_scr[pl.ds(b, 1)] = x_ref[0][None]
 
-    x = x_scr[pl.ds(b, 1), :]                       # (1, dm) residual
+    x = x_scr[pl.ds(b, 1)][0]                       # (t, dm) residual
 
     def ln(xx, s_ref, b_ref):
         # flax LayerNorm, op-for-op: fp32 fast-variance stats clipped at
@@ -218,7 +238,7 @@ def _fused_layers_kernel(
 
     # ---- attention leg ----
     h_ln = ln(x, ln1s, ln1b).astype(cdtype)
-    q_vec = lora("q_proj", h_ln, dense(h_ln, wq, bq))       # (1, hd)
+    q_vec = lora("q_proj", h_ln, dense(h_ln, wq, bq))       # (t, hd)
     k_vec = lora("k_proj", h_ln, dense(h_ln, wk, bk))
     v_vec = lora("v_proj", h_ln, dense(h_ln, wv, bv))
 
@@ -226,30 +246,40 @@ def _fused_layers_kernel(
     ks = ks_ref[0, 0] if quant else None                     # (s, h) fp32
     vs = vs_ref[0, 0] if quant else None
     col = jax.lax.broadcasted_iota(jnp.int32, (1, s), 1)
-    mask = col < start  # strictly: the current token rides in-register
+    mask = col < start  # strictly: the current tokens ride in-register
+    # Causal mask AMONG the t in-register positions: row j (cache slot
+    # start+j) sees in-register columns 0..j — together with the strict
+    # cache mask this is exactly the oracle's ``col <= start + row``.
+    rowq = jax.lax.broadcasted_iota(jnp.int32, (t, t), 0)
+    colq = jax.lax.broadcasted_iota(jnp.int32, (t, t), 1)
+    nmask = colq <= rowq
     if not quant:
-        k_out[0] = k_vec.astype(kv_dtype)
-        v_out[0] = v_vec.astype(kv_dtype)
+        k_out[0, 0] = k_vec.astype(kv_dtype)
+        v_out[0, 0] = v_vec.astype(kv_dtype)
 
     outs = []
     for gg in range(h):
         sl = slice(gg * d, (gg + 1) * d)
-        # The current token's k/v, exactly as a reader would see them
-        # AFTER the cache write: quantize (per-head fp32 scale, the
-        # quantize_kv reference arithmetic) then dequantize in-register —
-        # int8 attention is bit-identical to the oracle's
+        # The current tokens' k/v, exactly as a reader would see them
+        # AFTER the cache write: quantize (per-(position, head) fp32
+        # scale, the quantize_kv reference arithmetic) then dequantize
+        # in-register — int8 attention is bit-identical to the oracle's
         # write-then-dequant, and the raw values never touch HBM.
         if quant:
             kf = k_vec[:, sl].astype(jnp.float32)
             vf = v_vec[:, sl].astype(jnp.float32)
-            k_sc = jnp.maximum(jnp.max(jnp.abs(kf)), KV_SCALE_FLOOR) / 127.0
-            v_sc = jnp.maximum(jnp.max(jnp.abs(vf)), KV_SCALE_FLOOR) / 127.0
+            k_sc = jnp.maximum(
+                jnp.max(jnp.abs(kf), axis=-1, keepdims=True), KV_SCALE_FLOOR
+            ) / 127.0                                # (t, 1)
+            v_sc = jnp.maximum(
+                jnp.max(jnp.abs(vf), axis=-1, keepdims=True), KV_SCALE_FLOOR
+            ) / 127.0
             kq = jnp.clip(jnp.round(kf / k_sc), -127.0, 127.0)
             vq = jnp.clip(jnp.round(vf / v_sc), -127.0, 127.0)
-            k_out[0, :, sl] = kq.astype(kv_dtype)
-            v_out[0, :, sl] = vq.astype(kv_dtype)
-            ks_out[0, :, gg:gg + 1] = k_sc.reshape(1, 1)
-            vs_out[0, :, gg:gg + 1] = v_sc.reshape(1, 1)
+            k_out[0, 0, :, sl] = kq.astype(kv_dtype)
+            v_out[0, 0, :, sl] = vq.astype(kv_dtype)
+            ks_out[0, 0, :, gg:gg + 1] = k_sc
+            vs_out[0, 0, :, gg:gg + 1] = v_sc
             k_new = (kq * k_sc).astype(cdtype)
             v_new = (vq * v_sc).astype(cdtype)
             k_h = (kt[:, sl].astype(jnp.float32) * ks[:, gg:gg + 1]).astype(cdtype)
@@ -264,22 +294,33 @@ def _fused_layers_kernel(
         sc = jax.lax.dot_general(
             q_h, k_h, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
-        )                                            # (1, s) fp32
+        )                                            # (t, s) fp32
         sc = jnp.where(mask, sc, NEG_INF)
         sc_new = jax.lax.dot_general(
             q_h, k_new, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
-        )                                            # (1, 1) fp32
-        m = jnp.maximum(jnp.max(sc, axis=-1, keepdims=True), sc_new)
+        )                                            # (t, t) fp32
+        sc_new = jnp.where(nmask, sc_new, NEG_INF)
+        m = jnp.maximum(
+            jnp.max(sc, axis=-1, keepdims=True),
+            jnp.max(sc_new, axis=-1, keepdims=True),
+        )                                            # (t, 1); row 0's own
+        # diagonal score is always live, so m is finite even at start==0
         p = jnp.exp(sc - m)
-        p_new = jnp.exp(sc_new - m)
-        lsum = jnp.sum(p, axis=-1, keepdims=True) + p_new
+        p_new = jnp.exp(sc_new - m)                  # masked cols -> 0
+        lsum = (
+            jnp.sum(p, axis=-1, keepdims=True)
+            + jnp.sum(p_new, axis=-1, keepdims=True)
+        )
         acc = jax.lax.dot_general(
             p.astype(v_h.dtype), v_h, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
-        ) + p_new * v_new.astype(jnp.float32)        # (1, d) fp32
+        ) + jax.lax.dot_general(
+            p_new, v_new.astype(jnp.float32), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )                                            # (t, d) fp32
         outs.append((acc / lsum).astype(cdtype))
-    attn = jnp.concatenate(outs, axis=1)             # (1, hd)
+    attn = jnp.concatenate(outs, axis=1)             # (t, hd)
     o = lora("out_proj", attn, dense(attn, wo, bo))
     x = x + o.astype(x.dtype)
 
@@ -290,7 +331,7 @@ def _fused_layers_kernel(
     m2 = lora("fc2", g, dense(g, w2, b2))
     x = x + m2.astype(x.dtype)
 
-    x_scr[pl.ds(b, 1), :] = x
+    x_scr[pl.ds(b, 1)] = x[None]
     x_out[0] = x  # last write (l == L-1) wins; earlier flushes are dead
 
 
@@ -324,12 +365,13 @@ def _lora_inputs(lora_tree, cfg):
 
 
 def _fused_layers_call(x, blocks_p, blocks_c, idx, lora_tree, cfg):
-    """Invoke the megakernel: ``x`` (B, 1, d_model) post-embed residual,
-    ``blocks_p`` the stacked block params, ``blocks_c`` the attn cache
-    subtree, ``idx`` the scalar or (B,) frontier. Returns ``(x_out,
-    writes)`` where ``writes`` maps cache leaf name -> the (L, B, ...)
-    frontier updates the caller scatters in."""
-    b = x.shape[0]
+    """Invoke the megakernel: ``x`` (B, t, d_model) post-embed residual
+    (t == 1 for plain decode, t <= ``_SPEC_MAX_K`` for a speculative
+    verify window), ``blocks_p`` the stacked block params, ``blocks_c``
+    the attn cache subtree, ``idx`` the scalar or (B,) frontier. Returns
+    ``(x_out, writes)`` where ``writes`` maps cache leaf name -> the
+    (L, B, t, ...) frontier updates the caller scatters in."""
+    b, t = x.shape[0], x.shape[1]
     dm, H, D = cfg.d_model, cfg.n_heads, cfg.head_dim
     hd, L, S = H * D, cfg.n_layers, cfg.max_seq_len
     cdtype = _DTYPES[cfg.compute_dtype]
@@ -362,7 +404,7 @@ def _fused_layers_call(x, blocks_p, blocks_c, idx, lora_tree, cfg):
     row4 = lambda l, bb: (l, bb, 0, 0)  # noqa: E731
     in_specs = [
         pl.BlockSpec(memory_space=pltpu.SMEM),                     # frontier
-        pl.BlockSpec((1, 1, dm), lambda l, bb: (bb, 0, 0)),        # x
+        pl.BlockSpec((1, t, dm), lambda l, bb: (bb, 0, 0)),        # x
         *[wspec(w) for w in weights],
         pl.BlockSpec((1, 1, S, hd), row4),                         # K row
         pl.BlockSpec((1, 1, S, hd), row4),                         # V row
@@ -380,23 +422,23 @@ def _fused_layers_call(x, blocks_p, blocks_c, idx, lora_tree, cfg):
         args.append(arr)
 
     out_shapes = [
-        jax.ShapeDtypeStruct((b, 1, dm), cdtype),                  # x_out
-        jax.ShapeDtypeStruct((L, b, hd), kv_dtype),                # k_new
-        jax.ShapeDtypeStruct((L, b, hd), kv_dtype),                # v_new
+        jax.ShapeDtypeStruct((b, t, dm), cdtype),                  # x_out
+        jax.ShapeDtypeStruct((L, b, t, hd), kv_dtype),             # k_new
+        jax.ShapeDtypeStruct((L, b, t, hd), kv_dtype),             # v_new
     ]
     out_specs = [
-        pl.BlockSpec((1, 1, dm), lambda l, bb: (bb, 0, 0)),
-        pl.BlockSpec((1, 1, hd), lambda l, bb: (l, bb, 0)),
-        pl.BlockSpec((1, 1, hd), lambda l, bb: (l, bb, 0)),
+        pl.BlockSpec((1, t, dm), lambda l, bb: (bb, 0, 0)),
+        pl.BlockSpec((1, 1, t, hd), row4),
+        pl.BlockSpec((1, 1, t, hd), row4),
     ]
     if quant:
-        out_shapes += [jax.ShapeDtypeStruct((L, b, H), jnp.float32)] * 2
-        out_specs += [pl.BlockSpec((1, 1, H), lambda l, bb: (l, bb, 0))] * 2
+        out_shapes += [jax.ShapeDtypeStruct((L, b, t, H), jnp.float32)] * 2
+        out_specs += [pl.BlockSpec((1, 1, t, H), row4)] * 2
 
     res = pl.pallas_call(
         functools.partial(
             _fused_layers_kernel,
-            h=H, d=D, s=S, dm=dm, quant=quant, per_row=per_row,
+            h=H, d=D, s=S, t=t, dm=dm, quant=quant, per_row=per_row,
             lora_sites=lora_sites, lora_per_row=lora_per_row,
             lora_scale=float(cfg.adapter.scale), cdtype=cdtype,
             kv_dtype=kv_dtype,
@@ -405,7 +447,7 @@ def _fused_layers_call(x, blocks_p, blocks_c, idx, lora_tree, cfg):
         in_specs=in_specs,
         out_specs=out_specs,
         out_shape=out_shapes,
-        scratch_shapes=[pltpu.VMEM((max(b, 8), dm), cdtype)],
+        scratch_shapes=[pltpu.VMEM((max(b, 8), t, dm), cdtype)],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("arbitrary", "arbitrary"),
         ),
@@ -419,16 +461,17 @@ def _fused_layers_call(x, blocks_p, blocks_c, idx, lora_tree, cfg):
 
 
 def _scatter_frontier(cache_leaf, update, idx):
-    """Write the (L, B, X) frontier updates into the (L, B, S, X) stacked
-    cache at the scalar — or per-row (B,) — frontier: ONE dynamic update
-    per leaf for the whole layer stack (the O(1)-launch property the
-    megakernel exists for)."""
+    """Write the (L, B, t, X) frontier updates into the (L, B, S, X)
+    stacked cache at the scalar — or per-row (B,) — frontier: ONE
+    dynamic update per leaf for the whole layer stack (the O(1)-launch
+    property the megakernel exists for). ``t`` rows land contiguously at
+    ``idx .. idx+t-1`` — the verify window's k positions in one slice."""
     if idx.ndim == 0:
         return jax.lax.dynamic_update_slice(
-            cache_leaf, update[:, :, None, :], (0, 0, idx, 0)
+            cache_leaf, update, (0, 0, idx, 0)
         )
     return jax.vmap(
-        lambda c, u, i: jax.lax.dynamic_update_slice(c, u[:, None, :], (0, i, 0)),
+        lambda c, u, i: jax.lax.dynamic_update_slice(c, u, (0, i, 0)),
         in_axes=(1, 1, 0), out_axes=1,
     )(cache_leaf, update, idx)
 
@@ -446,9 +489,15 @@ def _block_subtree(tree):
 
 
 def fused_decode_step(model, params, cache, tok, lora=None):
-    """The ``decode_attention: fused_layers`` single-token step —
+    """The ``decode_attention: fused_layers`` step —
     :func:`dtc_tpu.generate.decode_step`'s fast path, shared verbatim by
-    the greedy scan and the serving engine.
+    the greedy scan and the serving engine. ``tok`` is (B, 1) for plain
+    decode or (B, k) for a speculative verify window (ISSUE 19): the k
+    logits rows come back in ONE launch, the k cache writes land in one
+    stacked scatter, and rollback after partial acceptance is a frontier
+    decrement by the caller (positions past the frontier are invisible —
+    every read masks ``col < frontier`` — and are rewritten by whichever
+    later step advances over them, so no cache surgery ever happens).
 
     Embed and head apply the REAL flax modules on their param subtrees
     (identical ops to the per-layer path — parity by construction); the

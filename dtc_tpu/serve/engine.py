@@ -38,6 +38,22 @@ vLLM-style paged budget, adapted to the model-native packed cache):
 - SLO accounting through ``obs``: queue-wait / TTFT / ms-per-token
   histograms, shed/evict/expire/reject/retry counters, and one
   ``serve_request`` event per terminal request — no silent drops.
+- Speculative decoding (``ServeConfig.spec``, ISSUE 19): a resident
+  shallow DRAFT rung (the target's bottom ``draft_layers``, extracted at
+  construction — ``dtc_tpu/spec/draft.py``) proposes ``spec_k - 1``
+  tokens per iteration and ONE k-query verify launch accepts a prefix of
+  them, so an iteration emits 1..spec_k tokens per slot instead of
+  exactly one. Greedy acceptance keeps the output token-identical to
+  plain decode by construction. The draft's KV rides the SAME page pool
+  (a proportional ``draft_layers / n_layers`` surcharge in
+  ``_pages_needed``); rounds are atomic in-jit, so eviction / failover /
+  corruption recovery land at iteration boundaries exactly as before —
+  re-admission re-prefills BOTH caches and resumes token-identically.
+  Honesty plumbing: rejected-draft wall time is a typed badput class
+  (``spec_rejected_draft``, never productive_decode), the SLO monitor is
+  fed ACCEPTED-tokens/s (a collapsing accept rate degrades admissions
+  like a latency breach), and every ServeResult carries
+  ``n_spec_proposed/accepted`` so accept_rate is per-request observable.
 - Multi-tenant LoRA adapters (``dtc_tpu/adapters/``, model config
   ``adapter.rank > 0``): one resident ``(max_adapters, ...)`` stacked
   factor buffer over ONE base model — slot 0 pinned to the all-zero base
@@ -68,7 +84,7 @@ from dtc_tpu.adapters import (
     validate_lora_tree,
 )
 from dtc_tpu.generate import decode_step, init_cache
-from dtc_tpu.obs.goodput import OnlineGoodput
+from dtc_tpu.obs.goodput import SPEC_REJECTED_DRAFT, OnlineGoodput
 from dtc_tpu.obs.registry import MetricsRegistry
 from dtc_tpu.obs.slo import SloMonitor
 from dtc_tpu.obs.trace import FlightRecorder, Tracer
@@ -77,6 +93,7 @@ from dtc_tpu.resilience.events import RecoveryBus
 from dtc_tpu.resilience.retry import retry_call
 from dtc_tpu.resilience.watchdog import StepWatchdog
 from dtc_tpu.serve.paged_cache import PageAllocator, kv_token_bytes, pages_for
+from dtc_tpu.spec import check_spec_backend, extract_draft, serve_round
 from dtc_tpu.serve.request import (
     TERMINAL_STATES,
     DeadlineExceededError,
@@ -252,6 +269,38 @@ class ServingEngine:
             self.lora_stack = None
             self.slot_adapter = None
 
+        # Speculative decoding (ISSUE 19): extract the resident draft
+        # rung ONCE at construction (a zero-copy layer slice of the
+        # target params) and give it its own per-slot cache next to the
+        # target's. Spec is adapter-free by design: the draft shares the
+        # target's embed/head by reference and verify runs the BASE
+        # model, so a per-tenant adapter would fork draft and target
+        # distributions silently — fail typed at construction instead.
+        spec_cfg = getattr(cfg, "spec", None)
+        self.spec_on = spec_cfg is not None and spec_cfg.enabled
+        if self.spec_on and self.lora_on:
+            raise ValueError(
+                "speculative decoding (serve.spec) does not compose with "
+                "multi-tenant adapters (model adapter.rank > 0): the draft "
+                "rung proposes under base weights while each tenant's "
+                "verify would run adapted weights — acceptance would "
+                "collapse and the draft KV surcharge would be priced "
+                "wrong; serve an adapter-free config"
+            )
+        if self.spec_on:
+            check_spec_backend(self.mcfg)  # token-identity needs one path
+            self.draft_model, self.draft_params = extract_draft(
+                model, params, spec_cfg.draft_layers
+            )
+            self.draft_cache = init_slot_cache(self.draft_model, cfg.slots)
+        else:
+            self.draft_model = self.draft_params = self.draft_cache = None
+        # Accepted-token throughput window for the SLO floor: emitted
+        # tokens and round count since the last SLO check (host ints).
+        self._spec_emitted_since = 0
+        self._spec_rounds_since = 0
+        self._spec_rate_t0 = self.clock()
+
         self.cache = init_slot_cache(model, cfg.slots)
         self.slots = [_Slot() for _ in range(cfg.slots)]
         self.last_tok = np.zeros((cfg.slots,), np.int32)
@@ -270,6 +319,8 @@ class ServingEngine:
         self._fps_memo: Any = None  # checksum table for the CURRENT cache
 
         self._build_fns()
+        if self.spec_on:
+            self._build_spec_fns()
         self._settle_cache_sharding()
 
     def _settle_cache_sharding(self) -> None:
@@ -488,9 +539,52 @@ class ServingEngine:
             getattr(self, "_adapter_insert_fn", None),
         )
 
+    def _build_spec_fns(self) -> None:
+        """The draft-side jitted fn for spec mode: a batch-1 prefill over
+        the SAME padded prompt shapes the target prefill uses (so the
+        two caches' frontiers agree at admission). Cached per
+        (model, page_size, draft_layers) for the same replica-sharing
+        reason as ``_FN_CACHE``; the round itself is the module-level
+        :func:`dtc_tpu.spec.serve_round` (shared process-wide via jit's
+        own cache — flax modules hash by structure). No finite check /
+        retry on the draft: a poisoned draft can only lower acceptance
+        (the verify re-derives every emitted token from TARGET logits),
+        never corrupt output — the target verify's finite flag is the
+        retry trigger. Insert/rollback reuse the generic tree-map
+        ``insert_fn`` and the in-round index decrement respectively, so
+        the draft cache adds no new surgery paths."""
+        key = (
+            self.model, self.cfg.page_size, "spec_prefill",
+            self.cfg.spec.draft_layers,
+        )
+        fn = ServingEngine._FN_CACHE.get(key)
+        if fn is None:
+            draft_model = self.draft_model
+
+            @jax.jit
+            def draft_prefill_fn(params, cache, prompt):
+                cache, _ = decode_step(draft_model, params, cache, prompt)
+                return cache
+
+            ServingEngine._FN_CACHE[key] = fn = draft_prefill_fn
+        self._draft_prefill_fn = fn
+
     # ------------------------------------------------------------------
     # submission (admission control)
     # ------------------------------------------------------------------
+    def _pages_needed(self, n_tokens: int) -> int:
+        """Page-pool footprint for ``n_tokens`` resident TARGET tokens —
+        plus the draft rung's proportional KV surcharge under speculation
+        (ISSUE 19): the draft cache holds the same positions at
+        ``draft_layers`` of ``n_layers`` depth and rides the SAME pool,
+        so every admission/decode reservation prices it or the pool
+        over-commits exactly when speculation is on."""
+        pages = pages_for(n_tokens, self.cfg.page_size)
+        if self.spec_on:
+            dl, nl = self.cfg.spec.draft_layers, self.mcfg.n_layers
+            pages += (pages * dl + nl - 1) // nl
+        return pages
+
     def submit(self, req: Request, *, resume: ServeResult | None = None) -> str:
         """Enqueue one request. Typed backpressure — raises
         :class:`QueueFullError` past ``queue_depth`` and
@@ -530,21 +624,30 @@ class ServingEngine:
             )
         now = self.clock()
         total = len(req.prompt) + req.max_new_tokens
-        if total > self.mcfg.max_seq_len:
+        # Speculation headroom (ISSUE 19): the verify window physically
+        # writes spec_k positions from the frontier before rolling back,
+        # so the last round still needs spec_k - 1 slots past the final
+        # token — a request admitted without them would clamp its verify
+        # writes mid-flight. Priced at submit, typed, never mid-decode.
+        spec_pad = self.cfg.spec.spec_k - 1 if self.spec_on else 0
+        if total + spec_pad > self.mcfg.max_seq_len:
             self.reg.counter("serve_rejected").inc()
             self.reg.emit("serve_reject", rid=req.rid, reason="too_large")
             raise RequestTooLargeError(
                 f"request {req.rid}: prompt ({len(req.prompt)}) + "
-                f"max_new_tokens ({req.max_new_tokens}) exceeds max_seq_len "
-                f"({self.mcfg.max_seq_len})"
+                f"max_new_tokens ({req.max_new_tokens})"
+                + (f" + spec_k-1 verify headroom ({spec_pad})" if spec_pad
+                   else "")
+                + f" exceeds max_seq_len ({self.mcfg.max_seq_len})"
             )
-        if pages_for(total, self.cfg.page_size) > self.alloc.total_pages:
+        if self._pages_needed(total + spec_pad) > self.alloc.total_pages:
             self.reg.counter("serve_rejected").inc()
             self.reg.emit("serve_reject", rid=req.rid, reason="too_large")
             raise RequestTooLargeError(
                 f"request {req.rid}: footprint "
-                f"{pages_for(total, self.cfg.page_size)} pages exceeds the "
-                f"pool ({self.alloc.total_pages})"
+                f"{self._pages_needed(total + spec_pad)} pages"
+                + (" (incl. draft KV surcharge)" if self.spec_on else "")
+                + f" exceeds the pool ({self.alloc.total_pages})"
             )
         if req.adapter is not None and (
             not self.lora_on or req.adapter not in self.adapter_store
@@ -584,6 +687,10 @@ class ServingEngine:
             res.n_retries = resume.n_retries
             res.n_hops = resume.n_hops + 1
             res.degraded = resume.degraded
+            # Acceptance telemetry carries over: per-request accept_rate
+            # must cover the whole request, not just the last hop.
+            res.n_spec_proposed = resume.n_spec_proposed
+            res.n_spec_accepted = resume.n_spec_accepted
             res.requeued_t = now  # this hop's req.queued span starts here
         self.results[req.rid] = res
         ttl = self.cfg.deadline_s if req.deadline_s is None else req.deadline_s
@@ -762,6 +869,22 @@ class ServingEngine:
                 self.reg.emit("hung_step", runtime="serve", **flag)
                 self.dump_flight("hung_step", iteration=self._it)
         if self.slo is not None and self._it % self._slo_check_every == 0:
+            if self.spec_on and self._spec_rounds_since > 0:
+                # Feed the SLO floor ACCEPTED-tokens/s over the window
+                # since the last check (only when rounds actually ran —
+                # an idle engine's zero-rate must not fake a breach).
+                # This is the "price accepted tokens, not proposals"
+                # contract: a draft whose acceptance collapses breaches
+                # the floor and degrades admissions (degrade_active)
+                # even while launches-per-second looks healthy.
+                rate = self._spec_emitted_since / max(
+                    now_it - self._spec_rate_t0, 1e-9
+                )
+                self.reg.gauge("serve_accepted_tokens_per_s").set(rate)
+                self.slo.observe("serve_accepted_tokens_per_s", rate)
+                self._spec_emitted_since = 0
+                self._spec_rounds_since = 0
+                self._spec_rate_t0 = now_it
             self.slo.evaluate(iteration=self._it)
         return bool(self.queue) or any(s.rid is not None for s in self.slots)
 
@@ -901,7 +1024,11 @@ class ServingEngine:
                 key=lambda r: (r.priority, -self.results[r.rid].submitted_t),
             )
             seq = list(cand.prompt) + self.results[cand.rid].tokens
-            need = pages_for(len(seq) + 1, self.cfg.page_size)
+            # Reserve through the FIRST decode write: +1 token plain,
+            # +spec_k under speculation (the verify window), with the
+            # draft surcharge folded in by _pages_needed.
+            first_write = self.cfg.spec.spec_k if self.spec_on else 1
+            need = self._pages_needed(len(seq) + first_write)
             if not self._make_room(need, cand.priority):
                 return  # pool-bound: wait (deadlines/shedding keep it honest)
             # Reserve BEFORE the prefix store can pin pages out from under
@@ -1114,6 +1241,26 @@ class ServingEngine:
             self.cache, cache1, jnp.int32(slot_i), jnp.int32(len(seq))
         )
         self._fps_memo = None
+        if self.spec_on:
+            # Prefill the draft rung over the FULL sequence (no prefix
+            # store on the draft — its prefill is draft_layers/n_layers
+            # of the target's, and sharing target-built prefix KV is
+            # shape-impossible) and land its frontier at len(seq), the
+            # same place the target insert pinned. Re-admission after
+            # eviction/failover passes through here too, so a recovered
+            # request resumes with BOTH caches rebuilt — no mid-rollback
+            # state can survive a recovery (rounds are atomic in-jit).
+            dpad = _pad_to_bucket(
+                seq, self.cfg.prefill_bucket, self.mcfg.max_seq_len
+            )
+            dcache1 = self._draft_prefill_fn(
+                self.draft_params, init_cache(self.draft_model, 1),
+                jnp.asarray(np.asarray(dpad, np.int32)[None]),
+            )
+            self.draft_cache = self._insert_fn(
+                self.draft_cache, dcache1, jnp.int32(slot_i),
+                jnp.int32(len(seq)),
+            )
         slot = self.slots[slot_i]
         slot.rid = req.rid
         slot.frontier = len(seq)
@@ -1185,12 +1332,16 @@ class ServingEngine:
 
     def _ensure_pages(self) -> None:
         """Before decoding, every active slot needs pages covering its
-        NEXT write (frontier + 1). Exhaustion evicts the lowest-priority,
-        most-recently-admitted request — possibly the grower itself."""
+        NEXT write (frontier + 1 plain; frontier + spec_k under
+        speculation — the verify writes the whole window before rolling
+        back, and the draft surcharge rides along via _pages_needed).
+        Exhaustion evicts the lowest-priority, most-recently-admitted
+        request — possibly the grower itself."""
+        step_write = self.cfg.spec.spec_k if self.spec_on else 1
         for i, slot in enumerate(self.slots):
             if slot.rid is None:
                 continue
-            need = pages_for(slot.frontier + 1, self.cfg.page_size)
+            need = self._pages_needed(slot.frontier + step_write)
             while not self.alloc.ensure(slot.rid, need):
                 key = self.alloc.evict_prefix_lru()
                 if key is not None:
@@ -1210,6 +1361,8 @@ class ServingEngine:
                     break
 
     def _decode(self) -> None:
+        if self.spec_on:
+            return self._decode_spec()
         active = [
             (i, s.rid) for i, s in enumerate(self.slots) if s.rid is not None
         ]
@@ -1304,6 +1457,155 @@ class ServingEngine:
             self._maybe_complete(i, now=now)
         self.reg.counter("serve_decode_steps").inc()
         self.reg.histogram("serve_batch_occupancy").observe(len(active))
+
+    def _decode_spec(self) -> None:
+        """One speculative iteration over the in-flight batch: ONE round
+        (draft propose + single k-verify launch + greedy accept +
+        rollback — :func:`dtc_tpu.spec.serve_round`) emits 1..spec_k
+        tokens per active slot. Same retry / poison-localization /
+        page-fingerprint contract as :meth:`_decode`; the extras are the
+        honesty plumbing — emitted-vs-window goodput split, per-request
+        proposal/acceptance counts, and the accepted-tokens/s SLO feed."""
+        active = [
+            (i, s.rid) for i, s in enumerate(self.slots) if s.rid is not None
+        ]
+        if not active:
+            return
+        self._worked = True
+        t_dec = self.clock()
+        spec_k = self.cfg.spec.spec_k
+        # Retry re-runs bit-exactly from the PRE-round caches (greedy, no
+        # rng) — both references held until the round is accepted.
+        prev_cache, prev_draft = self.cache, self.draft_cache
+        toks = jnp.asarray(self.last_tok)[:, None]
+        remaining = np.zeros((self.cfg.slots,), np.int32)
+        for i, rid in active:
+            remaining[i] = max(
+                self._eff_max_new[rid] - len(self.results[rid].tokens), 0
+            )
+        rem = jnp.asarray(remaining)  # 0 freezes idle slots' frontiers
+        last_fin = np.ones((self.cfg.slots,), bool)
+
+        def attempt():
+            nonlocal last_fin
+            tcache, dcache, _tok_next, emit, n_emit, fin = serve_round(
+                self.model, self.draft_model, spec_k, self.params,
+                self.draft_params, prev_cache, prev_draft, toks, rem,
+            )
+            emit = np.asarray(emit)
+            n_emit = np.asarray(n_emit)
+            fin = np.asarray(fin).copy()
+            if self.chaos is not None and self.chaos.serve_poison_logits(
+                self._it
+            ):
+                fin[:] = False  # the observed device buffer reads back NaN
+            last_fin = fin
+            if not all(bool(fin[i]) for i, _ in active):
+                raise TransientStepError(
+                    f"non-finite logits in spec verify (iteration {self._it})"
+                )
+            return tcache, dcache, emit, n_emit
+
+        r = self.cfg.retry
+        self._retry_scope = [rid for _, rid in active]
+        try:
+            tcache, dcache, emit, n_emit = retry_call(
+                attempt, transient=(TransientStepError,),
+                max_attempts=r.max_attempts, backoff_s=r.backoff_s,
+                backoff_max_s=r.backoff_max_s, jitter=r.jitter,
+                max_elapsed_s=r.max_elapsed_s, on_event=self._on_retry_event,
+                sleep=self.sleep, clock=self.clock,
+            )
+        except TransientStepError as e:
+            # Same blast-radius localization as _decode: only slots whose
+            # verify logits read non-finite on the LAST attempt fail; the
+            # round's outputs were discarded, so healthy co-scheduled
+            # requests retry next iteration from intact pre-round caches
+            # (no frontier moved — rounds are atomic).
+            for i, rid in active:
+                if bool(last_fin[i]):
+                    continue
+                self._release_slot(rid)
+                err = RequestFailedError(
+                    f"request {rid}: spec verify retries exhausted"
+                )
+                err.__cause__ = e
+                self._finish(rid, RequestState.FAILED, err)
+            return
+        finally:
+            self._retry_scope = []
+        self.cache, self.draft_cache = tcache, dcache
+        self._fps_memo = None
+        now = self.clock()
+        n_active = len(active)
+        emitted = int(sum(int(n_emit[i]) for i, _ in active))
+        # Goodput honesty (the ISSUE 19 accounting contract): the round's
+        # wall time is split by the fraction of the verify window that
+        # EMITTED — the rest is the draft-proposal/verify work the target
+        # rejected, billed to the typed spec_rejected_draft badput class
+        # (never productive_decode) in both the online gauge and the
+        # offline span-ledger (a paired decode_step + spec_reject span).
+        dur = now - t_dec
+        frac = emitted / float(max(n_active * spec_k, 1))
+        t_split = t_dec + dur * frac
+        self.tracer.emit_span(
+            "decode_step", self._ts(t_dec), self._ts(t_split), cat="serve",
+            tid="sched", iteration=self._it, batch=n_active,
+            spec_k=spec_k, emitted=emitted,
+        )
+        if dur * (1.0 - frac) > 0.0:
+            self.tracer.emit_span(
+                "spec_reject", self._ts(t_split), self._ts(now), cat="serve",
+                tid="sched", iteration=self._it,
+                rejected=n_active * spec_k - emitted,
+            )
+        if self.goodput is not None:
+            self.goodput.note("productive_decode", dur * frac)
+            self.goodput.note(SPEC_REJECTED_DRAFT, dur * (1.0 - frac))
+            self._gp_work += dur
+        completed_pages = []  # (slot_i, page) finished this round
+        for i, rid in active:
+            slot = self.slots[i]
+            res = self.results[rid]
+            ne = int(n_emit[i])
+            new_toks = [int(t) for t in emit[i, :ne]]
+            res.n_spec_proposed += spec_k - 1
+            res.n_spec_accepted += max(ne - 1, 0)
+            req = self.requests[rid]
+            if req.eos_id is not None and req.eos_id in new_toks:
+                # Plain decode would have stopped AT the eos — truncate
+                # the emission there so the result is token-identical
+                # (the slot completes below; its frontier/cache state
+                # past the eos is idle-slot garbage from then on).
+                new_toks = new_toks[: new_toks.index(req.eos_id) + 1]
+            res.tokens.extend(new_toks)
+            if new_toks:
+                self.last_tok[i] = new_toks[-1]
+            old_pages = slot.frontier // self.cfg.page_size
+            slot.frontier += ne
+            if self._track_pages:
+                completed_pages.extend(
+                    (i, p) for p in range(
+                        old_pages, slot.frontier // self.cfg.page_size
+                    )
+                )
+            self.reg.histogram("serve_accepted_per_launch").observe(ne)
+        if completed_pages:
+            fps = self._page_fps()
+            for i, p in completed_pages:
+                self.slots[i].page_fp[p] = float(fps[i, p])
+        for i, _rid in active:
+            self._maybe_complete(i, now=now)
+        self._spec_emitted_since += emitted
+        self._spec_rounds_since += 1
+        self.reg.counter("serve_decode_steps").inc()
+        self.reg.counter("serve_spec_rounds").inc()
+        self.reg.counter("serve_spec_proposed").inc(n_active * (spec_k - 1))
+        self.reg.counter("serve_spec_accepted").inc(emitted - n_active)
+        self.reg.counter("serve_spec_rejected").inc(
+            n_active * (spec_k - 1) - (emitted - n_active)
+        )
+        self.reg.histogram("serve_batch_occupancy").observe(n_active)
 
     # ------------------------------------------------------------------
     # recovery paths
@@ -1446,6 +1748,11 @@ class ServingEngine:
                 ).observe(res.ms_per_token)
             if self.slo is not None:
                 self.slo.observe("serve_ms_per_token", res.ms_per_token)
+        if res.accept_rate is not None:
+            # Per-request acceptance (ISSUE 19) — every terminal outcome,
+            # not just DONE: a shed/expired request's acceptance is still
+            # real telemetry about the draft's fit to the workload.
+            self.reg.histogram("serve_accept_rate").observe(res.accept_rate)
         if self.slo is not None:
             self.slo.observe_outcome(
                 "serve_outcome_shed", state is RequestState.SHED

@@ -201,6 +201,12 @@ class ServeResult:
     # at the hop, while ttft_s stays anchored at the ORIGINAL submit, so
     # fleet TTFT histograms include (never under-report) failover cost.
     requeued_t: float | None = None
+    # Speculative decoding (ISSUE 19): draft proposals this request saw
+    # and how many the target accepted — 0/0 on a spec-off engine.
+    # Counts survive eviction/failover (they describe work done, and a
+    # re-prefill re-derives tokens, not proposals).
+    n_spec_proposed: int = 0
+    n_spec_accepted: int = 0
 
     @property
     def queue_wait_s(self) -> float | None:
@@ -225,6 +231,14 @@ class ServeResult:
             return None
         return (self.finished_t - self.first_token_t) / (len(self.tokens) - 1) * 1e3
 
+    @property
+    def accept_rate(self) -> float | None:
+        """Accepted / proposed draft tokens (ISSUE 19); None when the
+        request never decoded under speculation."""
+        if self.n_spec_proposed <= 0:
+            return None
+        return self.n_spec_accepted / self.n_spec_proposed
+
     def summary(self) -> dict[str, Any]:
         """JSON-ready record for telemetry / bench rows."""
         r3 = lambda v: None if v is None else round(v, 6)  # noqa: E731
@@ -241,4 +255,7 @@ class ServeResult:
             "n_hops": self.n_hops,
             "degraded": self.degraded,
             "adapter": self.adapter,
+            "n_spec_proposed": self.n_spec_proposed,
+            "n_spec_accepted": self.n_spec_accepted,
+            "accept_rate": r3(self.accept_rate),
         }

@@ -84,6 +84,8 @@ class FleetRecord:
     n_evictions: int = 0
     n_retries: int = 0
     degraded: bool = False
+    n_spec_proposed: int = 0
+    n_spec_accepted: int = 0
 
     def resume_result(self) -> ServeResult:
         """The partial result a survivor resumes from (the engine's
@@ -94,6 +96,8 @@ class FleetRecord:
             first_token_t=self.first_token_t, n_evictions=self.n_evictions,
             n_retries=self.n_retries, degraded=self.degraded,
             n_hops=self.hops, adapter=self.req.adapter,
+            n_spec_proposed=self.n_spec_proposed,
+            n_spec_accepted=self.n_spec_accepted,
         )
 
 
@@ -405,6 +409,8 @@ class FleetRouter:
             rec.n_evictions = res.n_evictions
             rec.n_retries = res.n_retries
             rec.degraded = res.degraded
+            rec.n_spec_proposed = res.n_spec_proposed
+            rec.n_spec_accepted = res.n_spec_accepted
         for rid, res in eng.drain_results().items():
             rec = self.records.pop(rid, None)
             if rec is None:
@@ -654,6 +660,8 @@ class FleetRouter:
             first_token_t=rec.first_token_t, finished_t=now,
             n_evictions=rec.n_evictions, n_retries=rec.n_retries,
             n_hops=rec.hops, degraded=rec.degraded, adapter=rec.req.adapter,
+            n_spec_proposed=rec.n_spec_proposed,
+            n_spec_accepted=rec.n_spec_accepted,
         )
         del self.records[rid]
         self.results[rid] = res

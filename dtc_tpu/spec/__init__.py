@@ -1,0 +1,24 @@
+"""Speculative decoding (ISSUE 19): draft-propose, megakernel k-verify,
+exactness-gated acceptance.
+
+- :mod:`dtc_tpu.spec.draft` — truncated-layer draft extraction: a
+  shallow rung of the SAME GPT family initialized from the target
+  checkpoint's bottom layers (the stacked ``(L, ...)`` block params make
+  this a zero-copy slice).
+- :mod:`dtc_tpu.spec.core` — the propose/verify/accept round:
+  ``spec_generate`` (the generate()-shaped driver), greedy
+  token-identity acceptance (emitted tokens == plain decode by
+  construction), and Leviathan-style rejection sampling for
+  ``temperature > 0`` (target-distribution exact).
+
+The serving integration (resident draft cache, per-slot rounds, goodput
+/ SLO honesty) lives in :mod:`dtc_tpu.serve.engine` behind
+``ServeConfig.spec``.
+"""
+
+from dtc_tpu.spec.draft import draft_config, extract_draft  # noqa: F401
+from dtc_tpu.spec.core import (  # noqa: F401
+    check_spec_backend,
+    serve_round,
+    spec_generate,
+)

@@ -1,0 +1,338 @@
+"""The speculative round: draft-propose, k-verify in one launch, accept.
+
+One ROUND emits between 1 and ``spec_k`` tokens of the target model:
+
+1. **Propose** — the draft rung (spec/draft.py) runs ``spec_k`` plain
+   single-token decode steps under ``lax.scan`` starting from the last
+   emitted token, yielding ``spec_k - 1`` proposals. (It takes one step
+   more than it strictly needs so its cache frontier lands at
+   ``start + spec_k`` — the same place the target's verify leaves ITS
+   frontier — making rollback a uniform index decrement on both.)
+2. **Verify** — ONE :func:`~dtc_tpu.generate.decode_step` call with the
+   ``(B, spec_k)`` window ``[t_last, d_1 .. d_{k-1}]`` and
+   ``spec_verify=True``: under ``decode_attention: fused_layers`` the
+   megakernel takes all k query positions in a single launch (causal
+   among the k in-register); the xla/fused fallback ladder computes the
+   identical logits (the parity oracle).
+3. **Accept** — greedy: proposal ``d_{j+1}`` is accepted iff it equals
+   the target's argmax at position j AND every earlier proposal was
+   accepted; the emitted tokens are the TARGET's argmax row, so the
+   output is token-identical to plain greedy decode *by construction*
+   (the draft can only change how many tokens each launch yields).
+   Sampled (``temperature > 0``): Leviathan et al.'s rejection rule —
+   accept ``d`` with probability ``min(1, q(d)/p(d))``, resample the
+   first rejection from ``normalize(max(q - p, 0))``, bonus-sample from
+   ``q`` when everything is accepted — which makes every emitted token
+   an EXACT sample from the target distribution, independent of draft
+   quality.
+4. **Rollback** — the verify wrote all ``spec_k`` positions and moved
+   the frontier to ``start + spec_k``; the round rebinds the cache
+   index to ``start + n_emit``. Positions past a frontier are invisible
+   (every decode read masks ``col < frontier``) and are rewritten by
+   whichever later step advances over them, so rejection costs ONE
+   integer per cache — no cache surgery, nothing for eviction/failover
+   to observe mid-flight (serve-side rounds are atomic in-jit).
+
+``spec_generate`` drives rounds to ``max_new_tokens`` with per-row
+frontiers — rows accept independently, so the batch decouples exactly
+like the serving engine's slots.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from dtc_tpu.generate import decode_step, init_cache
+
+PyTree = Any
+
+
+def _reindex(cache: PyTree, new_index) -> PyTree:
+    """Rebind the GPT-level frontier — THE rollback primitive."""
+    return {"index": new_index, "stage": cache["stage"]}
+
+
+def check_spec_backend(cfg) -> None:
+    """Exactness gate: greedy acceptance is token-identical to plain
+    decode only when the single-token path and the k-verify path share
+    ONE numeric implementation — ``fused_layers`` (the megakernel serves
+    both) or ``xla`` (the oracle serves both). ``fused`` runs the
+    per-layer Pallas kernel for single tokens but the xla oracle for the
+    multi-token verify window: two different accumulation orders, whose
+    bf16-compute logits disagree by enough to flip near-tie argmaxes —
+    the identity guarantee would silently become "usually identical".
+    Raised typed at spec_generate() / ServingEngine construction, never
+    discovered as a token mismatch mid-flight."""
+    if getattr(cfg, "decode_attention", None) == "fused":
+        raise ValueError(
+            "speculative decoding requires decode_attention='fused_layers' "
+            "or 'xla' (one numeric path for both plain decode and the "
+            "k-verify window); 'fused' pairs the per-layer kernel with the "
+            "xla verify oracle and greedy acceptance loses its "
+            "token-identity guarantee"
+        )
+
+
+def _propose_greedy(draft_model, draft_params, dcache, tok, spec_k):
+    """``spec_k`` draft steps from ``tok`` (B, 1); returns the advanced
+    draft cache (frontier +spec_k) and (B, spec_k - 1) proposals."""
+    def body(carry, _):
+        dc, t = carry
+        dc, logits = decode_step(draft_model, draft_params, dc, t)
+        nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+        return (dc, nxt[:, None]), nxt
+
+    (dcache, _), drafts = jax.lax.scan(
+        body, (dcache, tok), None, length=spec_k
+    )
+    return dcache, drafts[: spec_k - 1].T  # (B, k-1); last step cache-only
+
+
+def _propose_sampled(
+    draft_model, draft_params, dcache, tok, spec_k, temperature, rng
+):
+    """Sampled propose: like :func:`_propose_greedy` but each proposal is
+    drawn from the draft distribution at ``temperature``, and the full
+    per-step draft probabilities ride out for the rejection test."""
+    def body(carry, _):
+        dc, t, key = carry
+        dc, logits = decode_step(draft_model, draft_params, dc, t)
+        lg = logits[:, -1].astype(jnp.float32) / temperature
+        probs = jax.nn.softmax(lg, axis=-1)
+        key, sub = jax.random.split(key)
+        nxt = jax.random.categorical(sub, lg, axis=-1).astype(jnp.int32)
+        return (dc, nxt[:, None], key), (nxt, probs)
+
+    (dcache, _, _), (drafts, probs) = jax.lax.scan(
+        body, (dcache, tok, rng), None, length=spec_k
+    )
+    # drafts (k, B), probs (k, B, V); the k-th step only advances the cache.
+    return (
+        dcache,
+        drafts[: spec_k - 1].T,                     # (B, k-1)
+        probs[: spec_k - 1].transpose(1, 0, 2),     # (B, k-1, V)
+    )
+
+
+def _accept_sampled(proposals, p_probs, q_probs, rng):
+    """Leviathan-style rejection: returns ``(n_acc, t_extra)`` — the
+    accepted-proposal count per row and the resampled/bonus token that
+    always follows the accepted prefix. Pure (seeded) — unit-tested
+    against the analytic target distribution in tests/test_spec.py."""
+    b, km1 = proposals.shape
+    rows = jnp.arange(b)
+    q_d = jnp.take_along_axis(
+        q_probs[:, :km1], proposals[..., None], axis=2
+    )[..., 0]                                        # (B, k-1) q(d_j)
+    p_d = jnp.take_along_axis(p_probs, proposals[..., None], axis=2)[..., 0]
+    key_u, key_r = jax.random.split(rng)
+    u = jax.random.uniform(key_u, (b, km1))
+    # u < q/p without the division (p_d == 0 can only pair with a
+    # proposal of probability zero — accept iff q_d > 0, which the
+    # product form gets right).
+    acc = u * p_d < q_d
+    n_acc = jnp.sum(jnp.cumprod(acc.astype(jnp.int32), axis=1), axis=1)
+    # Residual distribution at the first rejection; bonus from q when
+    # every proposal was accepted (n_acc == k-1).
+    q_row = q_probs[rows, n_acc]                     # (B, V)
+    p_row = jnp.where(
+        (n_acc < km1)[:, None],
+        p_probs[rows, jnp.minimum(n_acc, km1 - 1)],
+        0.0,
+    )
+    resid = jnp.maximum(q_row - p_row, 0.0)
+    norm = jnp.sum(resid, axis=-1, keepdims=True)
+    resid = jnp.where(norm > 0, resid / norm, q_row)
+    t_extra = jax.random.categorical(
+        key_r, jnp.log(resid), axis=-1
+    ).astype(jnp.int32)
+    return n_acc, t_extra
+
+
+def spec_round(
+    model, draft_model, spec_k, params, draft_params,
+    tcache, dcache, tok, remaining, *, temperature=0.0, rng=None,
+):
+    """ONE propose/verify/accept/rollback round over a (B,)-frontier
+    batch. Pure and jit-safe (the serving engine jits it directly; jit
+    with ``static_argnums=(0, 1, 2)``).
+
+    ``tok`` (B, 1) is the last emitted token per row; ``remaining`` (B,)
+    caps emission (0 freezes a row: its frontier does not move and its
+    lanes compute masked garbage — the engine's idle slots, generate's
+    finished rows). Returns ``(tcache, dcache, tok_next, emit, n_emit,
+    fin)``: ``emit`` (B, spec_k) holds each row's emitted tokens in its
+    first ``n_emit`` columns, ``fin`` flags rows whose verify logits
+    were all finite (the engine's poison-localization hook)."""
+    start_t, start_d = tcache["index"], dcache["index"]
+    b = tok.shape[0]
+    rows = jnp.arange(b)
+    greedy = temperature == 0.0
+
+    if greedy:
+        dcache, proposals = _propose_greedy(
+            draft_model, draft_params, dcache, tok, spec_k
+        )
+    else:
+        rng, sub = jax.random.split(rng)
+        dcache, proposals, p_probs = _propose_sampled(
+            draft_model, draft_params, dcache, tok, spec_k, temperature, sub
+        )
+
+    verify_toks = jnp.concatenate([tok, proposals], axis=1)   # (B, k)
+    tcache, logits = decode_step(
+        model, params, tcache, verify_toks, spec_verify=True
+    )
+    fin = jnp.all(jnp.isfinite(logits.astype(jnp.float32)), axis=(1, 2))
+
+    if greedy:
+        target = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # (B, k)
+        match = verify_toks[:, 1:] == target[:, :-1]
+        n_acc = jnp.sum(jnp.cumprod(match.astype(jnp.int32), axis=1), axis=1)
+        emit = target  # accepted prefix == the target's own argmax row
+    else:
+        q_probs = jax.nn.softmax(
+            logits.astype(jnp.float32) / temperature, axis=-1
+        )
+        n_acc, t_extra = _accept_sampled(proposals, p_probs, q_probs, rng)
+        pos = jnp.arange(spec_k)[None]
+        prop_pad = jnp.pad(proposals, ((0, 0), (0, 1)))
+        emit = jnp.where(
+            pos < n_acc[:, None],
+            prop_pad,
+            jnp.where(pos == n_acc[:, None], t_extra[:, None], 0),
+        )
+
+    n_emit = jnp.where(
+        remaining > 0, jnp.clip(n_acc + 1, 1, remaining), 0
+    ).astype(jnp.int32)
+    tok_next = jnp.where(
+        n_emit > 0, emit[rows, jnp.maximum(n_emit, 1) - 1], tok[:, 0]
+    )[:, None]
+    tcache = _reindex(tcache, start_t + n_emit)
+    dcache = _reindex(dcache, start_d + n_emit)
+    return tcache, dcache, tok_next, emit, n_emit, fin
+
+
+#: Jitted round for the serving engine — ONE module-level wrapper so
+#: every in-process replica serving the same (model, draft, spec_k)
+#: shares the compiled executable (flax modules hash by structure; same
+#: sharing story as ServingEngine._FN_CACHE). The engine calls it
+#: greedy-only (ServeConfig validation pins acceptance="greedy").
+serve_round = jax.jit(spec_round, static_argnums=(0, 1, 2))
+
+
+@functools.partial(
+    jax.jit, static_argnums=(0, 1, 2, 3), static_argnames=("temperature",)
+)
+def _round_step(
+    model, draft_model, spec_k, max_new, params, draft_params,
+    tcache, dcache, tok, out, n_done, rng, *, temperature,
+):
+    """One jitted spec_generate iteration: round + ragged scatter of the
+    emitted tokens into the (B, max_new) output buffer."""
+    b = tok.shape[0]
+    rows = jnp.arange(b)
+    remaining = jnp.maximum(max_new - n_done, 0)
+    if temperature > 0.0:
+        rng, sub = jax.random.split(rng)
+    else:
+        sub = rng
+    tcache, dcache, tok, emit, n_emit, _ = spec_round(
+        model, draft_model, spec_k, params, draft_params,
+        tcache, dcache, tok, remaining, temperature=temperature, rng=sub,
+    )
+    cols = n_done[:, None] + jnp.arange(spec_k)[None]
+    valid = jnp.arange(spec_k)[None] < n_emit[:, None]
+    cols = jnp.where(valid, cols, max_new)          # OOB -> dropped
+    out = out.at[rows[:, None], cols].set(emit, mode="drop")
+    n_done = n_done + n_emit
+    n_acc = jnp.sum(jnp.maximum(n_emit - 1, 0))
+    return tcache, dcache, tok, out, n_done, rng, n_acc
+
+
+def spec_generate(
+    model,
+    params: PyTree,
+    draft_model,
+    draft_params: PyTree,
+    prompt: jax.Array,
+    max_new_tokens: int,
+    rng: jax.Array | None = None,
+    *,
+    spec_k: int,
+    temperature: float = 0.0,
+    return_stats: bool = False,
+) -> jax.Array:
+    """Speculative :func:`~dtc_tpu.generate.generate`: same contract —
+    ``(B, max_new_tokens)`` int32 continuations — served by draft-
+    propose/k-verify rounds. ``temperature == 0`` is token-identical to
+    plain greedy ``generate`` (asserted in tests/test_spec.py and
+    scripts/spec_smoke.py); ``temperature > 0`` is distribution-exact
+    via rejection sampling (``rng`` required). Top-k/top-p filters are
+    not supported with speculation (the rejection identity needs the
+    unfiltered target distribution).
+
+    ``return_stats`` also returns ``{"proposed": int, "accepted": int,
+    "rounds": int}`` — the acceptance telemetry every bench row and
+    smoke gate reads (``accept_rate = accepted / proposed``)."""
+    from dtc_tpu.ops.decode_fused import _SPEC_MAX_K
+
+    b, t_prompt = prompt.shape
+    cfg = model.cfg
+    check_spec_backend(cfg)
+    if not 2 <= spec_k <= _SPEC_MAX_K:
+        raise ValueError(f"spec_k must be in [2, {_SPEC_MAX_K}], got {spec_k}")
+    # The verify window physically writes spec_k positions from the
+    # frontier before rolling back, so the LAST round (one token left,
+    # frontier at t_prompt + max_new - 1) still needs spec_k slots.
+    if t_prompt + max_new_tokens + spec_k - 1 > cfg.max_seq_len:
+        raise ValueError(
+            f"prompt ({t_prompt}) + max_new_tokens ({max_new_tokens}) + "
+            f"spec_k-1 ({spec_k - 1}) verify headroom exceeds max_seq_len "
+            f"({cfg.max_seq_len}) — the KV cache cannot grow past it"
+        )
+    if temperature > 0.0 and rng is None:
+        raise ValueError("temperature sampling needs an rng key")
+    if rng is None:
+        rng = jax.random.PRNGKey(0)  # unused by greedy
+
+    tcache = init_cache(model, b)
+    dcache = init_cache(draft_model, b)
+    with jax.named_scope("prefill"):
+        tcache, logits = decode_step(model, params, tcache, prompt)
+        dcache, _ = decode_step(draft_model, draft_params, dcache, prompt)
+    rng, sub = jax.random.split(rng)
+    if temperature == 0.0:
+        first = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+    else:
+        first = jax.random.categorical(
+            sub, logits[:, -1].astype(jnp.float32) / temperature, axis=-1
+        ).astype(jnp.int32)
+
+    # Per-row frontiers from here on: rows accept independently.
+    vec = jnp.full((b,), t_prompt, jnp.int32)
+    tcache, dcache = _reindex(tcache, vec), _reindex(dcache, vec)
+    out = jnp.zeros((b, max_new_tokens), jnp.int32)
+    out = out.at[:, 0].set(first)
+    n_done = jnp.ones((b,), jnp.int32)
+    tok = first[:, None]
+
+    proposed = accepted = rounds = 0
+    while bool(jnp.any(n_done < max_new_tokens)):
+        tcache, dcache, tok, out, n_done, rng, n_acc = _round_step(
+            model, draft_model, spec_k, max_new_tokens, params, draft_params,
+            tcache, dcache, tok, out, n_done, rng, temperature=temperature,
+        )
+        rounds += 1
+        proposed += (spec_k - 1) * b
+        accepted += int(n_acc)
+    if return_stats:
+        return out, {
+            "proposed": proposed, "accepted": accepted, "rounds": rounds,
+        }
+    return out

@@ -1,0 +1,83 @@
+"""Draft-model extraction: a truncated-layer rung of the target GPT.
+
+Speculative decoding needs a proposer that is (a) much cheaper than the
+target and (b) distributionally close to it. The cheapest checkpoint-
+free answer is the target's own bottom layers: the ``nn.scan`` stacked
+block params already carry a leading ``(L,)`` layer axis, so a
+``draft_layers``-deep rung is literally ``leaf[:draft_layers]`` per
+block leaf — no re-init, no training, no weight copy beyond the slice
+(embed/head/ln_f subtrees are SHARED by reference with the target; jax
+arrays are immutable, so residency costs only the sliced blocks).
+
+This is the "early-exit as draft" construction (cf. self-speculative /
+layer-skip decoding): the rung reuses the target's lm_head over its
+layer-``draft_layers`` residual stream. Its proposals are imperfect —
+that is what verification is for — but on a trained checkpoint the
+bottom layers carry most next-token signal, and EXACTNESS never depends
+on draft quality: acceptance gates every emitted token against the
+target (spec/core.py), so a bad draft costs acceptance rate, not
+correctness.
+
+HBM math (why the draft rides along for ~free): draft KV pages cost
+``draft_layers / n_layers`` of the target's — on the flagship at int8
+KV a 3-of-12-layer draft adds 25% KV bytes, repaid when the mean
+accepted window exceeds 1.25 tokens per verify launch. The serving
+engine bills this honestly: draft pages ride the SAME paged-pool
+accounting as target pages (engine's spec page surcharge), never a
+hidden side allocation.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+
+PyTree = Any
+
+
+def draft_config(cfg, draft_layers: int):
+    """The draft rung's ModelConfig: ``cfg`` with ``n_layers`` truncated
+    (and adapters off — speculation is an adapter-free mode; the engine
+    enforces the same restriction). Everything else — widths, vocab,
+    ``max_seq_len``, decode backend, KV dtype — is inherited, so the
+    draft's cache rides the same kernels and the same pool arithmetic."""
+    if not 1 <= draft_layers < cfg.n_layers:
+        raise ValueError(
+            f"draft_layers must be in [1, {cfg.n_layers - 1}] "
+            f"(a strict truncation of the {cfg.n_layers}-layer target), "
+            f"got {draft_layers}"
+        )
+    return dataclasses.replace(
+        cfg,
+        n_layers=draft_layers,
+        adapter=dataclasses.replace(cfg.adapter, rank=0),
+    )
+
+
+def extract_draft(model, params: PyTree, draft_layers: int):
+    """Build ``(draft_model, draft_params)`` from the target checkpoint.
+
+    ``draft_params`` has the target's exact tree structure with the
+    stacked ``(L, ...)`` block leaves sliced to ``[:draft_layers]``;
+    the embed and head subtrees are the target's own (shared, not
+    copied). The returned model is a plain :class:`~dtc_tpu.models.gpt.
+    GPT` — every decode path (init_cache, decode_step, the fused
+    megakernel, the engine's slot caches) serves it unchanged."""
+    cfg = model.cfg
+    if cfg.moe_experts > 0:
+        raise ValueError(
+            "speculative draft extraction does not support MoE targets "
+            "(expert-stacked params have no bottom-layers truncation)"
+        )
+    from dtc_tpu.models.gpt import GPT
+
+    dcfg = draft_config(cfg, draft_layers)
+    dparams = dict(params)
+    stage = dict(params["stage"])
+    stage["blocks"] = jax.tree.map(
+        lambda leaf: leaf[:draft_layers], params["stage"]["blocks"]
+    )
+    dparams["stage"] = stage
+    return GPT(dcfg), dparams

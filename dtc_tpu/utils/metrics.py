@@ -243,6 +243,87 @@ def decode_roofline_ms(
     return total / (hbm_gbps * 1e9) * 1e3
 
 
+def spec_decode_step_flops(
+    cfg: ModelConfig, draft_cfg: ModelConfig, batch: int, cache_len: int,
+    spec_k: int,
+) -> float:
+    """Matmul FLOPs for ONE speculative round (ISSUE 19): the k-query
+    verify launch plus the draft's ``spec_k`` propose steps (the round
+    runs one draft step more than it strictly needs so both cache
+    frontiers land together — counted, because it is scheduled).
+
+    Verify: every one of the ``spec_k`` in-register query positions pays
+    the full dense 2·N_matmul pass, and its attention row reads
+    ``cache_len`` cache columns plus its in-window causal prefix —
+    ``Σ_j (cache_len + j) = k·cache_len + k(k-1)/2`` columns total.
+    """
+    n = param_count(cfg)
+    d = cfg.d_model
+    n_matmul = n - cfg.padded_vocab_size * d - cfg.max_seq_len * d
+    dense = 2.0 * n_matmul * batch * spec_k
+    cols = spec_k * cache_len + spec_k * (spec_k - 1) / 2.0
+    attn = 4.0 * cfg.n_layers * batch * cols * d
+    draft = spec_k * decode_step_flops(draft_cfg, batch, cache_len)
+    return dense + attn + draft
+
+
+def spec_decode_step_bytes(
+    cfg: ModelConfig, draft_cfg: ModelConfig, batch: int, cache_len: int,
+    spec_k: int,
+) -> dict[str, float]:
+    """Estimated HBM bytes for ONE speculative round — what makes
+    ``pct_of_roofline`` on spec bench rows honest about the draft's
+    bandwidth price (ISSUE 19). Components:
+
+    - ``weights`` / ``kv_read``: the TARGET's, read ONCE — this is the
+      whole speculative bet: one verify launch amortizes the dominant
+      stream over up to ``spec_k`` emitted tokens instead of one.
+    - ``kv_write`` / ``activations``: the target's, ×``spec_k`` — every
+      window position writes its k/v and runs the dense stack.
+    - ``draft``: ``spec_k`` FULL single-token draft steps (the
+      ``lax.scan`` re-reads the draft weights and its cache every step —
+      no amortization; this is the price the accepted-token rate must
+      repay, and at ``draft_layers/n_layers`` depth it is the term that
+      decides whether speculation wins on bandwidth at all).
+
+    Returns the components plus ``total``. Score spec rows against
+    ``ms_per_accepted_token``, never raw launch time: a row that hides
+    the draft term would report >100% roofline at accept_rate 0.
+    """
+    tb = decode_step_bytes(cfg, batch, cache_len)
+    draft = spec_k * decode_step_bytes(draft_cfg, batch, cache_len)["total"]
+    out = {
+        "weights": tb["weights"],
+        "kv_read": tb["kv_read"],
+        "kv_write": tb["kv_write"] * spec_k,
+        "activations": tb["activations"] * spec_k,
+        "lora": tb["lora"],  # structurally 0: spec serving is adapter-free
+        "draft": draft,
+    }
+    out["total"] = sum(out.values())
+    return out
+
+
+def tokens_accepted_per_launch(emitted: int, launches: int) -> float | None:
+    """Mean tokens landed per verify launch (``n_acc + 1`` per row per
+    round, so ∈ [1, spec_k] when speculation runs) — the launch-economy
+    numerator every spec bench row reports. None when nothing launched."""
+    if launches <= 0:
+        return None
+    return emitted / launches
+
+
+def ms_per_accepted_token(wall_s: float, emitted: int) -> float | None:
+    """Wall milliseconds per ACCEPTED (emitted) token — the spec-vs-plain
+    A/B metric: plain decode's equivalent is its ms/token, and a draft
+    only earns its keep when this comes in lower. Proposals never appear
+    in the denominator (the honesty rule the goodput ledger enforces on
+    the time side). None when nothing was emitted."""
+    if emitted <= 0:
+        return None
+    return wall_s * 1e3 / emitted
+
+
 def tp_sharded_param_count(cfg: ModelConfig) -> int:
     """Parameters Megatron TP actually shards over "model": the block
     matmul kernels, their COLUMN-parallel biases (qkv/fc1 — out_proj/fc2

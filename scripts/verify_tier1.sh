@@ -17,8 +17,8 @@ timeout -k 10 120 env JAX_PLATFORMS=cpu python -m pytest --collect-only -q -p no
   tests/test_serve.py tests/test_trace.py tests/test_devprof.py \
   tests/test_adapters.py tests/test_overlap_collectives.py \
   tests/test_router.py tests/test_elastic.py tests/test_goodput.py \
-  tests/test_pool.py > /dev/null || {
-    echo "tier-1 pre-gate: MoE/HLO/decode/analysis/serve/trace/devprof/adapters/overlap/router/elastic/goodput/pool test collection failed" >&2; exit 1; }
+  tests/test_pool.py tests/test_spec.py > /dev/null || {
+    echo "tier-1 pre-gate: MoE/HLO/decode/analysis/serve/trace/devprof/adapters/overlap/router/elastic/goodput/pool/spec test collection failed" >&2; exit 1; }
 # Pre-gate 2 (ISSUE 5 + 6): the graph audit — lower/compile the
 # dp/tp/fsdp/ep train steps (8-virtual-device CPU mesh), the greedy decode
 # scan, AND the serving (continuous-batching) decode step; run the rule
@@ -46,8 +46,13 @@ timeout -k 10 120 env JAX_PLATFORMS=cpu python -m pytest --collect-only -q -p no
 # (dtype-flow + dtype-literal lint) and memory (static HBM plan) passes
 # run ON BY DEFAULT, gating the <entry>.numerics.json / <entry>.memory.json
 # baselines alongside the graph fingerprints; timeout 960 -> 1080 for
-# the extra lower+compile+execute pass.)
-timeout -k 10 1080 env JAX_PLATFORMS=cpu python scripts/audit_graph.py \
+# the extra lower+compile+execute pass. ISSUE 19 grows it to 13: the
+# `serve_spec` entry audits one full speculative round — draft propose +
+# one-launch k-verify under admission churn (cold==1/steady==0), with the
+# zero-copy draft rung's weights reconciled as entry parameters in the
+# memory decomposition; timeout 1080 -> 1200 for the extra
+# lower+compile+execute pass.)
+timeout -k 10 1200 env JAX_PLATFORMS=cpu python scripts/audit_graph.py \
   --modes dp,tp,fsdp,ep,fsdp_overlapped,3d,bf16 --decode --serve --check-baselines || {
     echo "tier-1 pre-gate: graph audit failed (see findings above)" >&2; exit 1; }
 # Pre-gate 3 (ISSUE 6): fast scheduler smoke — four requests (two sharing
@@ -132,4 +137,15 @@ timeout -k 10 480 env JAX_PLATFORMS=cpu python scripts/pool_smoke.py || {
     echo "tier-1 pre-gate: pool smoke (diurnal) failed" >&2; exit 1; }
 timeout -k 10 480 env JAX_PLATFORMS=cpu python scripts/pool_smoke.py --chaos || {
     echo "tier-1 pre-gate: pool smoke (chaos) failed" >&2; exit 1; }
+# Pre-gate 11 (ISSUE 19): speculative-decoding smoke — draft extraction
+# (3-of-4 layer rung, shared embed/head), spec_generate + serve-engine
+# greedy token-identity vs plain generate() with accept_rate > 0, the
+# structural one-launch-per-verify while-census (the jitted spec round
+# under fused_layers must lower with strictly fewer HLO while loops
+# than the per-layer fused baseline — same baseline as devprof's decode
+# cross-check), and the goodput-honesty leg (ledger reconciles >= 99%
+# of wall-clock, rejected-proposal seconds billed to the TYPED
+# spec_rejected_draft class). ~1-2 min.
+timeout -k 10 300 env JAX_PLATFORMS=cpu python scripts/spec_smoke.py || {
+    echo "tier-1 pre-gate: speculative-decoding smoke failed" >&2; exit 1; }
 set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc

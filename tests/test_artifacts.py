@@ -196,3 +196,55 @@ def test_decode_drift_guard_same_config_only(tmp_path):
         "kv_cache_dtype": "int8",
     }}
     assert len(decode_drift_guard(extra, d)) == 1
+
+
+def test_decode_drift_guard_spec_keys(tmp_path):
+    """ISSUE 19 satellite: the same-config rule gains the speculative
+    keys (spec_k / draft_layers / spec_acceptance) — a spec row's
+    ms-per-ACCEPTED-token must never be judged against a plain row's
+    sequential ms/token (or vice versa), and rows committed before
+    ISSUE 19 normalize to spec-off (spec_k 0 / draft_layers 0 /
+    acceptance "off"), the config they actually ran — the same
+    normalization pattern as ISSUE 11's kv_cache_dtype above."""
+    from bench import decode_drift_guard
+
+    d = str(tmp_path)
+    _bench_file(
+        os.path.join(d, "BENCH_r01.json"),
+        {
+            "decode_b8": {  # pre-ISSUE-19: no spec fields
+                "ms_per_token": 5.0, "decode_attention": "fused_layers",
+                "kv_cache_dtype": "auto",
+            },
+            "spec_b8_k4": {
+                "ms_per_accepted_token": 2.0,
+                "decode_attention": "fused_layers",
+                "kv_cache_dtype": "auto", "spec_k": 4, "draft_layers": 2,
+                "spec_acceptance": "greedy",
+            },
+        },
+    )
+    base = {
+        "decode_attention": "fused_layers", "kv_cache_dtype": "auto",
+    }
+    # A label re-pointed from plain to speculative: not comparable — no
+    # flag despite 3x (accepted-token ms is a different metric).
+    extra = {"decode_b8": dict(
+        base, ms_per_token=15.0, spec_k=4, draft_layers=2,
+        spec_acceptance="greedy",
+    )}
+    assert decode_drift_guard(extra, d) == []
+    # Spec-off run vs the normalized pre-ISSUE-19 row: still guarded.
+    extra = {"decode_b8": dict(
+        base, ms_per_token=15.0, spec_k=0, draft_layers=0,
+        spec_acceptance="off",
+    )}
+    assert len(decode_drift_guard(extra, d)) == 1
+    # Spec row vs its committed spec self (the spec_* family, guarded on
+    # ms-per-ACCEPTED-token): matching explicit keys flag; a different
+    # spec_k (2 vs 4) is a different config — silent.
+    spec = dict(base, spec_k=4, draft_layers=2, spec_acceptance="greedy")
+    extra = {"spec_b8_k4": dict(spec, ms_per_accepted_token=9.0)}
+    assert len(decode_drift_guard(extra, d)) == 1
+    extra = {"spec_b8_k4": dict(spec, ms_per_accepted_token=9.0, spec_k=2)}
+    assert decode_drift_guard(extra, d) == []

@@ -1,0 +1,722 @@
+"""Speculative-decoding tests (ISSUE 19): draft extraction, the
+propose/verify/accept round, and the serving engine's spec mode.
+
+The anchor invariant, inherited from test_serve.py and sharpened: greedy
+speculation is a pure REGROUPING of plain greedy decode — same tokens,
+fewer launches. Every test here pins some face of that identity:
+
+- ``spec_generate`` vs ``generate`` token-for-token, on BOTH exact
+  backends (``fused_layers`` megakernel, ``xla`` oracle) and k widths;
+- the exactness gate: ``decode_attention: "fused"`` pairs the per-layer
+  kernel (t=1) with the xla verify oracle (t=k) — two accumulation
+  orders whose near-tie argmaxes flip — so it is REJECTED typed, never
+  discovered as a token mismatch;
+- rejection sampling (temperature > 0) emits EXACT target-distribution
+  samples independent of draft quality, checked against the analytic
+  distribution;
+- the engine's spec mode under chaos: eviction / preemption / corruption
+  / poison / replica kill mid-speculation all recover to token-identical
+  output (rounds are atomic in-jit — recovery is boundary-only, rollback
+  leaves no mid-flight frontier to observe);
+- the honesty plumbing: accepted-tokens/s SLO floor degrades admissions,
+  rejected-draft wall-clock lands in the typed ``spec_rejected_draft``
+  badput class, and per-request ``accept_rate`` is observable.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from dtc_tpu.config.schema import (
+    AdapterConfig,
+    ChaosConfig,
+    ModelConfig,
+    RouterConfig,
+    ServeConfig,
+    SloConfig,
+    SpecConfig,
+    StreamRetryConfig,
+)
+from dtc_tpu.generate import generate
+from dtc_tpu.models.gpt import GPT
+from dtc_tpu.obs import MemorySink
+from dtc_tpu.serve import (
+    FleetRouter,
+    Request,
+    RequestState,
+    RequestTooLargeError,
+    ServingEngine,
+)
+from dtc_tpu.spec import (
+    check_spec_backend,
+    draft_config,
+    extract_draft,
+    spec_generate,
+)
+
+VOCAB = 97
+
+
+def _model_and_params(**overrides):
+    kw = dict(
+        vocab_size=VOCAB, d_model=64, n_layers=4, n_heads=4, d_ff=128,
+        max_seq_len=64, dropout=0.0, param_dtype="float32",
+        compute_dtype="float32", attention="dense",
+        decode_attention="fused_layers",
+    )
+    kw.update(overrides)
+    cfg = ModelConfig(**kw)
+    model = GPT(cfg)
+    params = model.init(
+        {"params": jax.random.PRNGKey(0)}, jnp.ones((1, 1), jnp.int32),
+        train=False,
+    )["params"]
+    return model, params
+
+
+@pytest.fixture(scope="module")
+def spec_model():
+    """One tiny fused_layers GPT shared by the module (init is the
+    expensive part). max_seq_len 64 leaves verify-window headroom the
+    serve fixture's 32 would not."""
+    return _model_and_params()
+
+
+def _prompts(seed, sizes):
+    rng = np.random.RandomState(seed)
+    return [rng.randint(0, VOCAB, size=n).tolist() for n in sizes]
+
+
+def _refs(model, params, prompts, n):
+    return [
+        np.asarray(generate(
+            model, params, jnp.asarray(p, jnp.int32)[None], n
+        ))[0].tolist()
+        for p in prompts
+    ]
+
+
+# ---------------------------------------------------------------------------
+# draft extraction (spec/draft.py)
+# ---------------------------------------------------------------------------
+
+def test_draft_config_bounds_and_adapter_off():
+    cfg = ModelConfig(
+        vocab_size=VOCAB, d_model=64, n_layers=4, n_heads=4, d_ff=128,
+        max_seq_len=64, adapter=AdapterConfig(rank=4),
+    )
+    for bad in (0, 4, 5, -1):
+        with pytest.raises(ValueError, match="draft_layers"):
+            draft_config(cfg, bad)
+    d = draft_config(cfg, 2)
+    assert d.n_layers == 2
+    assert d.adapter.rank == 0          # speculation is adapter-free
+    assert d.max_seq_len == cfg.max_seq_len
+    assert d.decode_attention == cfg.decode_attention
+
+
+def test_extract_draft_slices_blocks_and_shares_embed(spec_model):
+    model, params = spec_model
+    dmodel, dparams = extract_draft(model, params, 2)
+    assert dmodel.cfg.n_layers == 2
+    # Stacked block leaves: leading (L,) axis truncated to draft depth.
+    for t_leaf, d_leaf in zip(
+        jax.tree.leaves(params["stage"]["blocks"]),
+        jax.tree.leaves(dparams["stage"]["blocks"]),
+    ):
+        assert d_leaf.shape == (2,) + t_leaf.shape[1:]
+        np.testing.assert_array_equal(
+            np.asarray(d_leaf), np.asarray(t_leaf[:2])
+        )
+    # Everything OUTSIDE the blocks is the target's own subtree — shared
+    # by reference, not copied (the residency-for-free claim).
+    for k, v in params["stage"].items():
+        if k != "blocks":
+            assert dparams["stage"][k] is v
+
+
+def test_extract_draft_rejects_moe():
+    cfg = ModelConfig(
+        vocab_size=VOCAB, d_model=32, n_layers=2, n_heads=2, d_ff=64,
+        max_seq_len=16, moe_experts=4, moe_top_k=2,
+    )
+    model = GPT(cfg)
+    with pytest.raises(ValueError, match="MoE"):
+        extract_draft(model, {}, 1)
+
+
+def test_draft_runs_plain_decode(spec_model):
+    """The extracted rung is a plain GPT: generate() serves it unchanged
+    (same kernels, same cache) — the property the engine's shared
+    insert/prefill plumbing relies on."""
+    model, params = spec_model
+    dmodel, dparams = extract_draft(model, params, 1)
+    out = generate(
+        dmodel, dparams, jnp.asarray([[1, 2, 3]], jnp.int32), 4
+    )
+    assert out.shape == (1, 4)
+
+
+# ---------------------------------------------------------------------------
+# the exactness gate
+# ---------------------------------------------------------------------------
+
+def test_check_spec_backend_gate():
+    base = dict(
+        vocab_size=VOCAB, d_model=64, n_layers=4, n_heads=4, d_ff=128,
+        max_seq_len=64,
+    )
+    check_spec_backend(ModelConfig(**base, decode_attention="fused_layers"))
+    check_spec_backend(ModelConfig(**base, decode_attention="xla"))
+    with pytest.raises(ValueError, match="token-identity"):
+        check_spec_backend(ModelConfig(**base, decode_attention="fused"))
+
+
+def test_spec_generate_rejects_mixed_backend():
+    model, params = _model_and_params(
+        n_layers=2, d_model=32, n_heads=2, d_ff=64,
+        decode_attention="fused",
+    )
+    dmodel, dparams = extract_draft(model, params, 1)
+    with pytest.raises(ValueError, match="fused_layers"):
+        spec_generate(
+            model, params, dmodel, dparams,
+            jnp.asarray([[1, 2]], jnp.int32), 4, spec_k=2,
+        )
+
+
+def test_engine_rejects_mixed_backend():
+    model, params = _model_and_params(
+        n_layers=2, d_model=32, n_heads=2, d_ff=64,
+        decode_attention="fused",
+    )
+    with pytest.raises(ValueError, match="fused_layers"):
+        ServingEngine(model, params, ServeConfig(
+            slots=1, page_size=4, prefill_bucket=8,
+            spec=SpecConfig(spec_k=2, draft_layers=1),
+        ))
+
+
+def test_engine_rejects_spec_plus_adapters():
+    model, params = _model_and_params(
+        n_layers=2, d_model=32, n_heads=2, d_ff=64,
+        adapter=AdapterConfig(rank=4),
+    )
+    with pytest.raises(ValueError, match="adapter"):
+        ServingEngine(model, params, ServeConfig(
+            slots=1, page_size=4, prefill_bucket=8,
+            spec=SpecConfig(spec_k=2, draft_layers=1),
+        ))
+
+
+# ---------------------------------------------------------------------------
+# spec_generate: greedy token-identity + input validation
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("backend", ["fused_layers", "xla"])
+@pytest.mark.parametrize("spec_k", [2, 4])
+def test_spec_generate_token_identical_to_generate(
+    spec_model, backend, spec_k
+):
+    """THE tentpole invariant: greedy speculation emits exactly plain
+    greedy decode's tokens on every exact backend and window width — the
+    draft (here a rough 2-of-4 rung on random weights) only changes how
+    many tokens each launch yields, never which."""
+    model, params = spec_model
+    if backend != model.cfg.decode_attention:
+        model = GPT(dataclasses.replace(model.cfg, decode_attention=backend))
+    dmodel, dparams = extract_draft(model, params, 2)
+    prompts = _prompts(11, (5, 9, 3))
+    max_new = 12
+    for p in prompts:
+        ref = np.asarray(generate(
+            model, params, jnp.asarray(p, jnp.int32)[None], max_new
+        ))[0].tolist()
+        out, stats = spec_generate(
+            model, params, dmodel, dparams,
+            jnp.asarray(p, jnp.int32)[None], max_new,
+            spec_k=spec_k, return_stats=True,
+        )
+        assert np.asarray(out)[0].tolist() == ref
+        # Stats sanity: the window arithmetic, not a quality bar.
+        assert stats["rounds"] >= 1
+        assert stats["proposed"] == stats["rounds"] * (spec_k - 1)
+        assert 0 <= stats["accepted"] <= stats["proposed"]
+
+
+def test_spec_generate_batch_rows_accept_independently(spec_model):
+    """Batched spec_generate with per-row frontiers must match per-row
+    plain decode even when rows accept at different rates (mixed-length
+    prompts padded into one batch would change the math, so compare
+    same-length rows)."""
+    model, params = spec_model
+    dmodel, dparams = extract_draft(model, params, 3)
+    rng = np.random.RandomState(5)
+    batch = jnp.asarray(rng.randint(0, VOCAB, size=(3, 6)), jnp.int32)
+    max_new = 10
+    ref = np.asarray(generate(model, params, batch, max_new))
+    out = np.asarray(spec_generate(
+        model, params, dmodel, dparams, batch, max_new, spec_k=3,
+    ))
+    np.testing.assert_array_equal(out, ref)
+
+
+def test_spec_generate_deep_draft_accepts(spec_model):
+    """A draft one layer short of the target tracks its argmax closely
+    even on random weights — acceptance must actually fire (>0), or the
+    whole launch-economy story is vacuous. (spec_smoke.py gates the same
+    property in CI.)"""
+    model, params = spec_model
+    dmodel, dparams = extract_draft(model, params, 3)
+    out, stats = spec_generate(
+        model, params, dmodel, dparams,
+        jnp.asarray(_prompts(2, (7,))[0], jnp.int32)[None], 16,
+        spec_k=2, return_stats=True,
+    )
+    assert stats["accepted"] > 0
+    assert stats["rounds"] < 16   # acceptance saved launches
+
+
+def test_spec_generate_validation(spec_model):
+    model, params = spec_model
+    dmodel, dparams = extract_draft(model, params, 2)
+    p = jnp.asarray([[1, 2, 3]], jnp.int32)
+    with pytest.raises(ValueError, match="spec_k"):
+        spec_generate(model, params, dmodel, dparams, p, 4, spec_k=1)
+    with pytest.raises(ValueError, match="max_seq_len"):
+        # 3 + 60 + (4-1) > 64: the verify window's write headroom.
+        spec_generate(model, params, dmodel, dparams, p, 60, spec_k=4)
+    with pytest.raises(ValueError, match="rng"):
+        spec_generate(
+            model, params, dmodel, dparams, p, 4, spec_k=2, temperature=0.7,
+        )
+
+
+# ---------------------------------------------------------------------------
+# rejection sampling: distribution exactness (seeded)
+# ---------------------------------------------------------------------------
+
+def test_rejection_rule_recovers_target_distribution():
+    """Leviathan acceptance is distribution-EXACT independent of the
+    draft: proposals drawn from an (intentionally wrong) draft
+    distribution p, filtered by ``_accept_sampled`` against a target q,
+    must leave the first emitted token distributed as q — checked
+    empirically over many seeded rows against the analytic q."""
+    from dtc_tpu.spec.core import _accept_sampled
+
+    v, b = 5, 4096
+    p = jnp.asarray([0.50, 0.20, 0.15, 0.10, 0.05])   # draft: wrong
+    q = jnp.asarray([0.10, 0.10, 0.30, 0.25, 0.25])   # target
+    key = jax.random.PRNGKey(7)
+    k_prop, k_acc = jax.random.split(key)
+    proposals = jax.random.categorical(
+        k_prop, jnp.log(p)[None].repeat(b, 0), axis=-1
+    ).astype(jnp.int32)[:, None]                       # (B, 1): k-1 = 1
+    p_probs = jnp.broadcast_to(p, (b, 1, v))
+    # q at BOTH window positions (position 1 feeds the bonus sample).
+    q_probs = jnp.broadcast_to(q, (b, 2, v))
+    n_acc, t_extra = _accept_sampled(
+        proposals, p_probs, q_probs, k_acc
+    )
+    first = jnp.where(n_acc >= 1, proposals[:, 0], t_extra)
+    counts = np.bincount(np.asarray(first), minlength=v)
+    emp = counts / b
+    tv = 0.5 * np.abs(emp - np.asarray(q)).sum()
+    assert tv < 0.03, f"TV(empirical, target) = {tv:.4f}"
+    # And acceptance really filtered: raw proposals are p-shaped, which
+    # is far from q (TV(p, q) = 0.40) — the rule did the correction.
+    raw = np.bincount(np.asarray(proposals[:, 0]), minlength=v) / b
+    assert 0.5 * np.abs(raw - np.asarray(q)).sum() > 0.2
+
+
+def test_rejection_rule_accepts_everything_when_draft_equals_target():
+    """p == q: accept probability min(1, q/p) is 1 everywhere, so every
+    proposal lands (modulo measure-zero u == 1) — the free-lunch limit."""
+    from dtc_tpu.spec.core import _accept_sampled
+
+    v, b, km1 = 7, 2048, 3
+    q = jnp.asarray(np.random.RandomState(0).dirichlet(np.ones(v)))
+    proposals = jax.random.categorical(
+        jax.random.PRNGKey(1), jnp.broadcast_to(jnp.log(q), (b, km1, v)),
+        axis=-1,
+    ).astype(jnp.int32)
+    p_probs = jnp.broadcast_to(q, (b, km1, v))
+    q_probs = jnp.broadcast_to(q, (b, km1 + 1, v))
+    n_acc, _ = _accept_sampled(
+        proposals, p_probs, q_probs, jax.random.PRNGKey(2)
+    )
+    assert int(jnp.sum(n_acc)) == b * km1
+
+
+def test_spec_generate_sampled_runs_and_stays_in_vocab(spec_model):
+    """End-to-end sampled path: shapes, vocab range, and stats plumbing
+    (the distribution identity itself is pinned analytically above — a
+    full-model empirical test would need thousands of generations)."""
+    model, params = spec_model
+    dmodel, dparams = extract_draft(model, params, 2)
+    out, stats = spec_generate(
+        model, params, dmodel, dparams,
+        jnp.asarray(_prompts(3, (4, 6))[:1][0], jnp.int32)[None], 8,
+        rng=jax.random.PRNGKey(42), spec_k=3, temperature=0.8,
+        return_stats=True,
+    )
+    out = np.asarray(out)
+    assert out.shape == (1, 8)
+    assert (0 <= out).all() and (out < model.cfg.padded_vocab_size).all()
+    assert stats["proposed"] == stats["rounds"] * 2
+
+
+# ---------------------------------------------------------------------------
+# roofline metrics (ISSUE 19 satellite — hand-computed)
+# ---------------------------------------------------------------------------
+
+def test_spec_decode_step_flops_hand_computed():
+    from dtc_tpu.utils.metrics import (
+        decode_step_flops,
+        spec_decode_step_flops,
+    )
+    from dtc_tpu.utils.metrics import param_count
+
+    cfg = ModelConfig(
+        vocab_size=VOCAB, d_model=64, n_layers=2, n_heads=4, d_ff=128,
+        max_seq_len=32,
+    )
+    dcfg = draft_config(cfg, 1)
+    batch, cache_len, k = 8, 20, 4
+    n_matmul = (
+        param_count(cfg) - cfg.padded_vocab_size * 64 - 32 * 64
+    )
+    dense = 2.0 * n_matmul * batch * k
+    # Verify attention: window position j reads cache_len + j columns.
+    cols = k * cache_len + k * (k - 1) / 2.0
+    attn = 4.0 * 2 * batch * cols * 64
+    draft = k * decode_step_flops(dcfg, batch, cache_len)
+    got = spec_decode_step_flops(cfg, dcfg, batch, cache_len, k)
+    assert got == pytest.approx(dense + attn + draft)
+    # And the whole point: one spec round costs far less than the k
+    # sequential full steps it replaces at full acceptance (weights are
+    # amortized in the byte model, not the FLOP model, so here the win
+    # is bounded — but the draft must at least be cheaper than k-1
+    # target steps).
+    assert draft < (k - 1) * decode_step_flops(cfg, batch, cache_len)
+
+
+def test_spec_decode_step_bytes_components():
+    from dtc_tpu.utils.metrics import decode_step_bytes, spec_decode_step_bytes
+
+    cfg = ModelConfig(
+        vocab_size=VOCAB, d_model=64, n_layers=4, n_heads=4, d_ff=128,
+        max_seq_len=32,
+    )
+    dcfg = draft_config(cfg, 1)
+    batch, cache_len, k = 4, 16, 3
+    tb = decode_step_bytes(cfg, batch, cache_len)
+    db = decode_step_bytes(dcfg, batch, cache_len)
+    got = spec_decode_step_bytes(cfg, dcfg, batch, cache_len, k)
+    # The speculative bet, stated in bytes: target weights + cache READ
+    # ONCE for the whole k-window; per-position work scales with k; the
+    # draft pays k FULL unamortized steps.
+    assert got["weights"] == tb["weights"]
+    assert got["kv_read"] == tb["kv_read"]
+    assert got["kv_write"] == tb["kv_write"] * k
+    assert got["activations"] == tb["activations"] * k
+    assert got["draft"] == k * db["total"]
+    assert got["lora"] == 0.0
+    assert got["total"] == pytest.approx(sum(
+        v for kk, v in got.items() if kk != "total"
+    ))
+    # Amortization holds at this shape: one round moves fewer bytes than
+    # the k sequential plain steps it can replace.
+    assert got["total"] < k * tb["total"]
+
+
+def test_accepted_token_rate_helpers():
+    from dtc_tpu.utils.metrics import (
+        ms_per_accepted_token,
+        tokens_accepted_per_launch,
+    )
+
+    assert tokens_accepted_per_launch(7, 2) == pytest.approx(3.5)
+    assert tokens_accepted_per_launch(0, 0) is None
+    assert tokens_accepted_per_launch(5, -1) is None
+    assert ms_per_accepted_token(0.010, 5) == pytest.approx(2.0)
+    assert ms_per_accepted_token(1.0, 0) is None
+
+
+# ---------------------------------------------------------------------------
+# serving engine: spec mode
+# ---------------------------------------------------------------------------
+
+def _spec_serve_cfg(**kw):
+    kw.setdefault("slots", 2)
+    kw.setdefault("page_size", 4)
+    kw.setdefault("queue_depth", 8)
+    kw.setdefault("max_new_tokens", 10)
+    kw.setdefault("prefill_bucket", 8)
+    kw.setdefault("spec", SpecConfig(spec_k=2, draft_layers=2))
+    return ServeConfig(**kw)
+
+
+def test_engine_spec_token_identity_and_telemetry(spec_model):
+    """Continuous batching WITH speculation: every output token-identical
+    to generate(), plus the per-request accept_rate and the spec counter
+    family the bench/smoke gates read."""
+    model, params = spec_model
+    prompts = _prompts(4, (6, 8, 5, 7))
+    refs = _refs(model, params, prompts, 10)
+    eng = ServingEngine(model, params, _spec_serve_cfg(
+        spec=SpecConfig(spec_k=4, draft_layers=3),
+    ))
+    sink = eng.reg.add_sink(MemorySink())
+    for i, p in enumerate(prompts):
+        eng.submit(Request(rid=f"r{i}", prompt=p, max_new_tokens=10))
+    res = eng.run(max_steps=400)
+    for i in range(len(prompts)):
+        assert res[f"r{i}"].state is RequestState.DONE
+        assert res[f"r{i}"].tokens == refs[i], f"r{i}"
+        assert res[f"r{i}"].n_spec_proposed > 0
+        assert res[f"r{i}"].accept_rate is not None
+    snap = eng.reg.snapshot()
+    assert snap["serve_spec_rounds"] >= 1
+    assert snap["serve_spec_proposed"] == snap["serve_spec_accepted"] + \
+        snap["serve_spec_rejected"]
+    # accept_rate reaches the histogram at terminal, one observation per
+    # completed request.
+    assert snap["serve_accept_rate"]["count"] == len(prompts)
+    # The ledger split: decode_step spans carry the window fields and
+    # any rejected remainder lands in a paired spec_reject span.
+    dspans = [e for e in sink.events if e["etype"] == "span"
+              and e.get("name") == "decode_step"]
+    assert dspans and all("spec_k" in e and "emitted" in e for e in dspans)
+
+
+def test_engine_spec_saves_launches(spec_model):
+    """The launch economy is real, not just counted: a deep draft at
+    spec_k=2 completes the same work in fewer decode iterations than the
+    plain engine (each accepted proposal saves one launch)."""
+    model, params = spec_model
+    prompts = _prompts(9, (6, 7))
+
+    def runs(spec):
+        eng = ServingEngine(model, params, _spec_serve_cfg(
+            max_new_tokens=12,
+            spec=spec,
+        ))
+        for i, p in enumerate(prompts):
+            eng.submit(Request(rid=f"r{i}", prompt=p, max_new_tokens=12))
+        res = eng.run(max_steps=400)
+        assert all(r.state is RequestState.DONE for r in res.values())
+        return eng.reg.snapshot()["serve_decode_steps"], res
+
+    plain_steps, plain = runs(SpecConfig())
+    spec_steps, spec = runs(SpecConfig(spec_k=2, draft_layers=3))
+    for rid in plain:
+        assert spec[rid].tokens == plain[rid].tokens
+    assert spec_steps < plain_steps
+
+
+def test_engine_spec_headroom_and_draft_surcharge_admission(spec_model):
+    """submit() prices the verify window and the draft KV honestly:
+    a prompt that fits plain decode but not prompt + max_new + spec_k - 1
+    is typed-rejected, as is one whose TARGET pages fit the pool but
+    target + draft surcharge does not."""
+    model, params = spec_model
+    # max_seq_len 64: 50 + 12 + (4-1) = 65 > 64 only because of the window.
+    eng = ServingEngine(model, params, _spec_serve_cfg(
+        spec=SpecConfig(spec_k=4, draft_layers=2), max_new_tokens=12,
+    ))
+    with pytest.raises(RequestTooLargeError, match="spec"):
+        eng.submit(Request(rid="big", prompt=[1] * 50, max_new_tokens=12))
+    eng.submit(Request(rid="ok", prompt=[1] * 49, max_new_tokens=12))
+
+    # Pool sizing: 6 pages of 4 hold the target's 17 peak tokens
+    # (5 pages) but not 5 + the draft's ceil(5*2/4) = 3 surcharge.
+    eng2 = ServingEngine(model, params, _spec_serve_cfg(
+        slots=1, total_pages=6,
+        spec=SpecConfig(spec_k=2, draft_layers=2), max_new_tokens=10,
+    ))
+    with pytest.raises(RequestTooLargeError, match="draft"):
+        eng2.submit(Request(rid="r", prompt=[1] * 6, max_new_tokens=10))
+
+
+def test_engine_spec_eviction_mid_speculation_is_bit_exact(spec_model):
+    """ISSUE 19 satellite: pool pressure evicts a request BETWEEN
+    speculative rounds; re-admission re-prefills prompt+generated into
+    BOTH caches and the continuation stays token-identical — no cache
+    frontier is ever observed mid-rollback (rounds are atomic in-jit,
+    so eviction only ever sees settled frontiers)."""
+    model, params = spec_model
+    prompts = _prompts(1, (6, 8, 5, 7))
+    refs = _refs(model, params, prompts, 10)
+    eng = ServingEngine(model, params, _spec_serve_cfg(
+        slots=3, total_pages=18, queue_depth=8,
+        spec=SpecConfig(spec_k=2, draft_layers=2),
+    ))
+    for i, p in enumerate(prompts):
+        eng.submit(Request(rid=f"r{i}", prompt=p, max_new_tokens=10))
+    res = eng.run(max_steps=500)
+    assert sum(r.n_evictions for r in res.values()) > 0
+    for i in range(4):
+        assert res[f"r{i}"].state is RequestState.DONE
+        assert res[f"r{i}"].tokens == refs[i], f"r{i}"
+    # Pool fully reclaimed — target AND draft pages.
+    assert eng.alloc.free_pages == eng.alloc.total_pages
+
+
+def test_engine_spec_eos_mid_window_truncates(spec_model):
+    """A verify window can overshoot the eos plain decode stops at; the
+    engine truncates the emission there so eos semantics stay identical."""
+    model, params = spec_model
+    p = _prompts(6, (5,))[0]
+    ref = _refs(model, params, [p], 10)[0]
+    eos = ref[3]  # stop four tokens in — guaranteed to be emitted
+    expect = ref[: ref.index(eos) + 1]
+    eng = ServingEngine(model, params, _spec_serve_cfg(
+        spec=SpecConfig(spec_k=4, draft_layers=3),
+    ))
+    eng.submit(Request(rid="r", prompt=p, max_new_tokens=10, eos_id=eos))
+    res = eng.run(max_steps=200)
+    assert res["r"].state is RequestState.DONE
+    assert res["r"].tokens == expect
+
+
+def test_engine_spec_chaos_acceptance(spec_model):
+    """The serve_spec chaos leg (ISSUE 19 satellite): the kill/corrupt/
+    poison acceptance run with speculation ON — preemption lands between
+    rounds, corruption is caught by page fingerprints over spec-written
+    pages, poisoned verify logits retry from pre-round caches — and
+    every completed request still matches the CLEAN plain-decode refs."""
+    model, params = spec_model
+    prompts = _prompts(4, (6, 8, 5, 7))
+    refs = _refs(model, params, prompts, 10)
+
+    def build(chaos):
+        return ServingEngine(model, params, _spec_serve_cfg(
+            verify_pages_every=1,
+            spec=SpecConfig(spec_k=2, draft_layers=2),
+            chaos=chaos or ChaosConfig(),
+        ))
+
+    def drive(eng):
+        for i, p in enumerate(prompts):
+            eng.submit(Request(rid=f"c{i}", prompt=p, max_new_tokens=10))
+        return eng.run(max_steps=600)
+
+    clean = drive(build(None))
+    eng = build(ChaosConfig(
+        enabled=True,
+        serve_preempt_at_step=4,
+        serve_corrupt_page_at_step=6,
+        serve_poison_logits_at_step=8,
+    ))
+    sink = eng.reg.add_sink(MemorySink())
+    faulted = drive(eng)
+
+    snap = eng.reg.snapshot()
+    assert snap["chaos_injections"] == 3
+    assert snap["serve_preemptions"] == 1
+    assert snap["serve_corruptions"] == 1
+    assert snap["serve_retries"] >= 1
+    for i in range(len(prompts)):
+        rid = f"c{i}"
+        assert faulted[rid].state is RequestState.DONE
+        # Both runs match each other AND plain generate() — speculation
+        # under chaos is still a pure regrouping of greedy decode.
+        assert faulted[rid].tokens == clean[rid].tokens == refs[i], rid
+    etypes = {e["etype"] for e in sink.events}
+    assert {"serve_request", "chaos", "serve_evict",
+            "serve_corruption"} <= etypes
+
+
+def test_fleet_kill_mid_speculation_fails_over_exactly(spec_model):
+    """Replica kill mid-speculation: the dead replica's in-flight
+    speculative request fails over (re-prefill on the survivor, both
+    caches) and completes token-identical to plain generate() — the
+    acceptance criterion's fleet leg."""
+    model, params = spec_model
+    p = _prompts(7, (6,))[0]
+    ref = _refs(model, params, [p], 10)[0]
+    router = FleetRouter(model, params, RouterConfig(
+        n_replicas=2,
+        retry=StreamRetryConfig(
+            max_attempts=2, backoff_s=0.0, backoff_max_s=0.0, jitter=0.0),
+        serve=_spec_serve_cfg(
+            slots=1, queue_depth=4,
+            spec=SpecConfig(spec_k=2, draft_layers=2),
+        ),
+    ))
+    router.submit(Request(rid="r0", prompt=p, max_new_tokens=10))
+    for _ in range(4):          # admit + a few speculative rounds
+        router.step()
+    assert len(router.records["r0"].tokens) >= 1  # mid-speculation
+    router.kill_replica(router.records["r0"].replica, reason="test")
+    res = router.run(max_steps=300)["r0"]
+    assert res.state is RequestState.DONE
+    assert res.tokens == ref
+    assert res.n_hops == 1
+    assert res.n_spec_proposed > 0
+
+
+def test_engine_spec_slo_floor_prices_accepted_tokens(spec_model):
+    """The honesty watermark: an unreachable accepted-tokens/s floor
+    breaches (typed slo_breach on accepted_tokens_per_s_min), flips
+    degrade_active, and new admissions degrade — all keyed off ACCEPTED
+    throughput, which no launch count can satisfy."""
+    model, params = spec_model
+    eng = ServingEngine(model, params, _spec_serve_cfg(
+        slots=1, queue_depth=8, max_new_tokens=12,
+        degrade_max_new_tokens=3,
+        spec=SpecConfig(spec_k=2, draft_layers=2),
+        slo=SloConfig(window=8, min_samples=2, check_every=2,
+                      accepted_tokens_per_s_min=1e12),
+    ))
+    sink = eng.reg.add_sink(MemorySink())
+    prompts = _prompts(8, (5, 6, 7, 5))
+    for i, p in enumerate(prompts):
+        eng.submit(Request(rid=f"r{i}", prompt=p, max_new_tokens=12))
+    res = eng.run(max_steps=400)
+    breaches = [e for e in sink.events if e["etype"] == "slo_breach"]
+    assert any(
+        e["objective"] == "accepted_tokens_per_s_min" for e in breaches
+    )
+    assert eng.slo.degrade_active
+    # The floor fed the gauge a real (finite) rate — launches happened,
+    # acceptance was priced, the threshold was simply unmeetable.
+    assert eng.reg.snapshot()["serve_accepted_tokens_per_s"] > 0
+    # Later admissions were degraded by the breach.
+    assert any(r.degraded and len(r.tokens) == 3 for r in res.values())
+
+
+def test_engine_spec_goodput_bills_rejected_draft_work(spec_model):
+    """Rejected-draft wall-clock lands in the TYPED spec_rejected_draft
+    class — never productive_decode — in both the online window and the
+    span stream (paired decode_step/spec_reject spans)."""
+    from dtc_tpu.obs.goodput import SPEC_REJECTED_DRAFT
+
+    model, params = spec_model
+    eng = ServingEngine(model, params, _spec_serve_cfg(
+        # Shallow draft: acceptance will be imperfect, so rejected work
+        # exists to bill.
+        spec=SpecConfig(spec_k=4, draft_layers=1),
+    ))
+    sink = eng.reg.add_sink(MemorySink())
+    for i, p in enumerate(_prompts(10, (6, 8))):
+        eng.submit(Request(rid=f"r{i}", prompt=p, max_new_tokens=10))
+    eng.run(max_steps=300)
+    classes = {k for k, _ in eng.goodput._win}
+    assert "productive_decode" in classes
+    assert SPEC_REJECTED_DRAFT in classes
+    rej = sum(s for k, s in eng.goodput._win if k == SPEC_REJECTED_DRAFT)
+    prod = sum(s for k, s in eng.goodput._win if k == "productive_decode")
+    assert rej > 0 and prod > 0
+    spans = [e for e in sink.events if e["etype"] == "span"]
+    names = {e.get("name") for e in spans}
+    assert "spec_reject" in names
+    # Span pairing: every spec_reject's wall-clock is disjoint from its
+    # decode_step twin (the split point is shared).
+    rejects = [e for e in spans if e.get("name") == "spec_reject"]
+    assert all(e["rejected"] > 0 for e in rejects)
