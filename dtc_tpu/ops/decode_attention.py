@@ -113,8 +113,19 @@ def _group(d: int, h: int) -> tuple[int, int]:
 
 
 def supports(s: int) -> bool:
-    """Whether the fused kernel handles a cache of length ``s``."""
-    return s <= _DECODE_MAX_SINGLE_S or s % _DECODE_BLOCK_S == 0
+    """Whether the fused kernel handles a cache of length ``s``.
+
+    The single-tile branch additionally clears the shared VMEM planner
+    (ops/vmem.py — every ``supports_*`` gate consults it, lint-enforced
+    by analysis/kernels.py). At the 14 MiB budget every cache under the
+    structural ``_DECODE_MAX_SINGLE_S`` bound fits — pinned in
+    tests/test_kernel_audit.py so this consult can never silently
+    change routing."""
+    from dtc_tpu.ops import vmem
+
+    if s <= _DECODE_MAX_SINGLE_S and vmem.decode_single_tile_fits(s):
+        return True
+    return s % _DECODE_BLOCK_S == 0
 
 
 def _head_kv(kt, vt, ks, vs, gg, d, out_dtype):

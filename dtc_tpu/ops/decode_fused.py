@@ -73,6 +73,7 @@ from jax.experimental.pallas import tpu as pltpu
 # Shared helpers; importing decode_attention also installs the jax-0.4.x
 # pltpu.CompilerParams alias (via flash_attention) every pallas_call
 # below relies on.
+from dtc_tpu.ops import vmem
 from dtc_tpu.ops.decode_attention import KV_SCALE_FLOOR, NEG_INF, _interpret
 
 _DTYPES = {
@@ -81,26 +82,24 @@ _DTYPES = {
 
 #: Longest cache the megakernel holds as one (S, H·D) tile per (layer,
 #: row) grid step — the same single-tile bound as the per-layer kernel.
-_FUSED_LAYERS_MAX_S = 4096
+#: Owned by the shared planner (ops/vmem.py) since ISSUE 20.
+_FUSED_LAYERS_MAX_S = vmem.FUSED_LAYERS_MAX_S
 
 #: Widest speculative verify window the megakernel serves as one launch
 #: (t query positions against the frontier, causal among themselves
-#: in-register). Tiny by design: speculation past ~8 proposals is
-#: acceptance-rate-limited, not launch-limited, and a small static bound
-#: keeps the (t, S) score tile inside the same VMEM envelope the
-#: single-query kernel already budgets.
-_SPEC_MAX_K = 8
+#: in-register). See ops/vmem.SPEC_MAX_K; spec/core.py imports this
+#: alias.
+_SPEC_MAX_K = vmem.SPEC_MAX_K
 
-#: Per-grid-step VMEM working-set budget: one layer's weights (param
-#: dtype) + one row's K/V cache tile (+ scales) must fit under this for
-#: the kernel to be schedulable. ~16 MB/core on v5e; 14 MB leaves
-#: headroom for activations/registers. The flagship (12.6 MB fp32
-#: weights + 1.05 MB bf16 row) fits single-buffered; whether Mosaic's
-#: cross-layer weight double-buffering also fits is a TPU-measurement
-#: question the standing tunnel outage defers (PERF.md round 10) — if it
-#: does not, this constant comes down and the per-layer kernel remains
-#: the fallback.
-_VMEM_BUDGET_BYTES = 14 * 1024 * 1024
+#: Per-grid-step VMEM working-set budget — the ONE shared constant in
+#: ops/vmem.py (ISSUE 20 unified this module's copy with
+#: overlap_collectives'). The flagship (12.6 MB fp32 weights + 1.05 MB
+#: bf16 row) fits single-buffered; the planner's
+#: ``fits_double_buffered`` answers the cross-layer double-buffering
+#: question statically (it does NOT fit at 14 MiB — PERF.md "Kernel
+#: audit"), so the per-layer kernel remains the fallback if Mosaic
+#: insists on prefetching.
+_VMEM_BUDGET_BYTES = vmem.VMEM_BUDGET_BYTES
 
 #: LoRA site order the kernel threads factors in (a subset, filtered by
 #: presence in the model's "lora" collection).
@@ -110,45 +109,37 @@ _LORA_MLP_SITES = ("fc1", "fc2")
 _LN_EPS = 1e-6  # flax.linen.LayerNorm default, the model's setting
 
 
-def _param_bytes(name: str) -> int:
-    from dtc_tpu.config.schema import DTYPE_BYTES
-
-    return DTYPE_BYTES.get(name, 4)
-
-
-def supports_fused_layers(cfg) -> bool:
-    """Whether the megakernel can serve ``cfg``'s single-token decode.
+def supports_fused_layers(cfg, t: int = 1) -> bool:
+    """Whether the megakernel can serve ``cfg``'s decode at verify-window
+    width ``t`` (1 = plain single-token decode).
 
     MoE blocks (expert dispatch inside a kernel is future work), caches
     past the single-tile bound, and per-step working sets over the VMEM
-    budget all decline — callers fall back to the per-layer path."""
-    if cfg.moe_experts > 0:
-        return False
-    if cfg.max_seq_len > _FUSED_LAYERS_MAX_S:
-        return False
-    d, ff = cfg.d_model, cfg.d_ff
-    hd = cfg.n_heads * cfg.head_dim
-    pb = _param_bytes(cfg.param_dtype)
-    weights = (4 * (d * d + d) + 2 * d * ff + ff + d + 4 * d) * pb
-    if cfg.kv_quantized:
-        row = 2 * cfg.max_seq_len * (hd + 4 * cfg.n_heads)
-    else:
-        row = 2 * cfg.max_seq_len * hd * _param_bytes(cfg.kv_store_dtype)
-    return weights + row <= _VMEM_BUDGET_BYTES
+    budget all decline — callers fall back to the per-layer path. The
+    byte accounting is :func:`dtc_tpu.ops.vmem.fused_layers_plan` —
+    derived from the SAME grid plan :func:`_fused_layers_call` builds
+    its BlockSpecs from, and t-aware since ISSUE 20: a speculative
+    verify window's k query/score rows, k cache writes per layer, and
+    k-wide residual scratch are priced as a surcharge over the
+    single-query baseline instead of riding a gate that only priced one
+    row."""
+    return vmem.fused_layers_plan(cfg, t=t)["fits"]
 
 
 def use_fused_layers(cfg, t_new: int, verify: bool = False) -> bool:
     """The decode_step routing predicate: knob on, single-token call (or
     a ``verify`` call of up to ``_SPEC_MAX_K`` query positions — the
-    speculative k-token verify, ISSUE 19), supported shape. Prefill
-    (multi-token WITHOUT ``verify``) keeps falling back to the per-layer
-    path: a prompt pass is compute-bound and belongs to XLA's fusions,
-    while a verify window is the same frontier-append regime as decode."""
+    speculative k-token verify, ISSUE 19), supported shape AT THIS
+    WIDTH (the planner prices the verify window's working set, not just
+    a single query row). Prefill (multi-token WITHOUT ``verify``) keeps
+    falling back to the per-layer path: a prompt pass is compute-bound
+    and belongs to XLA's fusions, while a verify window is the same
+    frontier-append regime as decode."""
     ok_t = t_new == 1 or (verify and 2 <= t_new <= _SPEC_MAX_K)
     return (
         getattr(cfg, "decode_attention", None) == "fused_layers"
         and ok_t
-        and supports_fused_layers(cfg)
+        and supports_fused_layers(cfg, t=t_new)
     )
 
 
@@ -395,45 +386,34 @@ def _fused_layers_call(x, blocks_p, blocks_c, idx, lora_tree, cfg):
     ]
     lora_sites, lora_arrays, lora_per_row = _lora_inputs(lora_tree, cfg)
 
-    def wspec(arr):
-        # One layer's block: (1, *feature dims), b-invariant index map so
-        # the pipeline re-fetches weights only when l advances.
-        shape = (1,) + tuple(arr.shape[1:])
-        return pl.BlockSpec(shape, lambda l, bb: (l,) + (0,) * (len(shape) - 1))
+    # Block shapes and index maps come from the shared static planner —
+    # the SAME grid plan ops/vmem.fused_layers_plan prices and the
+    # kernel auditor (analysis/kernels.py) lints, so the VMEM gate, the
+    # committed baselines, and the launched kernel cannot drift apart.
+    plan = vmem.fused_layers_grid_plan(
+        cfg, t=t, b=b, lora_sites=lora_sites, lora_per_row=lora_per_row,
+    )
 
-    row4 = lambda l, bb: (l, bb, 0, 0)  # noqa: E731
-    in_specs = [
-        pl.BlockSpec(memory_space=pltpu.SMEM),                     # frontier
-        pl.BlockSpec((1, t, dm), lambda l, bb: (bb, 0, 0)),        # x
-        *[wspec(w) for w in weights],
-        pl.BlockSpec((1, 1, S, hd), row4),                         # K row
-        pl.BlockSpec((1, 1, S, hd), row4),                         # V row
-    ]
+    def _spec(entry):
+        _name, shape, imap, space, _nbytes = entry
+        if space == "smem":
+            return pl.BlockSpec(memory_space=pltpu.SMEM)
+        return pl.BlockSpec(shape, imap)
+
+    in_specs = [_spec(e) for e in plan["in_specs"]]
     args = [idx_arr, x, *weights, blocks_c["k"], blocks_c["v"]]
     if quant:
-        in_specs += [pl.BlockSpec((1, 1, S, H), row4)] * 2
         args += [blocks_c["k_scale"], blocks_c["v_scale"]]
-    for arr in lora_arrays:
-        if lora_per_row:                                           # (L,B,in,r)
-            spec = pl.BlockSpec((1, 1) + tuple(arr.shape[2:]), row4)
-        else:                                                      # (L,in,r)
-            spec = wspec(arr)
-        in_specs.append(spec)
-        args.append(arr)
+    args += lora_arrays
 
+    out_specs = [_spec(e) for e in plan["out_specs"]]
     out_shapes = [
         jax.ShapeDtypeStruct((b, t, dm), cdtype),                  # x_out
         jax.ShapeDtypeStruct((L, b, t, hd), kv_dtype),             # k_new
         jax.ShapeDtypeStruct((L, b, t, hd), kv_dtype),             # v_new
     ]
-    out_specs = [
-        pl.BlockSpec((1, t, dm), lambda l, bb: (bb, 0, 0)),
-        pl.BlockSpec((1, 1, t, hd), row4),
-        pl.BlockSpec((1, 1, t, hd), row4),
-    ]
     if quant:
         out_shapes += [jax.ShapeDtypeStruct((L, b, t, H), jnp.float32)] * 2
-        out_specs += [pl.BlockSpec((1, 1, t, H), row4)] * 2
 
     res = pl.pallas_call(
         functools.partial(
@@ -447,7 +427,9 @@ def _fused_layers_call(x, blocks_p, blocks_c, idx, lora_tree, cfg):
         in_specs=in_specs,
         out_specs=out_specs,
         out_shape=out_shapes,
-        scratch_shapes=[pltpu.VMEM((max(b, 8), t, dm), cdtype)],
+        scratch_shapes=[
+            pltpu.VMEM(shape, cdtype) for shape, _nb in plan["scratch"]
+        ],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("arbitrary", "arbitrary"),
         ),

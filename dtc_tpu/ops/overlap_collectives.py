@@ -67,18 +67,38 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 from jax.sharding import PartitionSpec as P
 
+from dtc_tpu.ops import vmem
 from dtc_tpu.ops.flash_attention import _interpret  # noqa: F401  (shared gate)
 from dtc_tpu.utils.compat import shard_map
 
-#: VMEM budget for the fused kernels (same convention as
-#: ops/decode_fused._VMEM_BUDGET_BYTES): operands + per-chunk receive
-#: slots + the f32 accumulator must fit, else the decomposed ring runs.
-_VMEM_BUDGET_BYTES = 14 * 1024 * 1024
+#: VMEM budget for the fused kernels — the ONE shared constant in
+#: ops/vmem.py (ISSUE 20 unified this module's copy with
+#: decode_fused's): operands + per-chunk receive slots + the f32
+#: accumulator must fit, else the decomposed ring runs.
+_VMEM_BUDGET_BYTES = vmem.VMEM_BUDGET_BYTES
 
 #: Lane-dim dynamic slices inside the kernels start at ``block * step``;
 #: Mosaic wants them 128-aligned on hardware (interpret mode does not
 #: care — how the tiny-mesh CPU tests drive the real kernels).
-_LANE = 128
+_LANE = vmem.LANE
+
+#: DMA-schedule recording seam (ISSUE 20). When
+#: ``analysis/kernels.capture_schedule`` installs a list here, the ring
+#: kernels append one dict per schedule event — DMA start/wait, shared-
+#: buffer load/store — at kernel TRACE time. Events carry only STATIC
+#: metadata (ring step ``s``, buffer name, symbolic slot): under
+#: shard_map the kernel body traces once with ``lax.axis_index`` a
+#: tracer, so concrete slots are written as ("rel", off) =
+#: ``(device_idx + off) % ring`` or ("abs", k), and the auditor
+#: instantiates them per device to reconstruct the CONCURRENT schedule
+#: interpret-mode execution serializes. Zero overhead when None (every
+#: hook is a no-op attribute check).
+_SCHED_LOG = None
+
+
+def _sched(kind: str, **fields) -> None:
+    if _SCHED_LOG is not None:
+        _SCHED_LOG.append(dict(kind=kind, **fields))
 
 
 def _backend_override() -> str:
@@ -99,20 +119,13 @@ def _pallas_ok(
     matmul+reduce-scatter), so the VMEM budget must clear the WORST of
     their working sets — gating on the forward alone would select pallas
     for a shape whose backward then dies in Mosaic instead of taking the
-    documented decomposed fallback."""
-    blk = (k_loc if shard_axis == 0 else n_loc) // ring
-    if not _interpret() and blk % _LANE != 0:
+    documented decomposed fallback. The byte accounting is
+    :func:`dtc_tpu.ops.vmem.overlap_plan` (the shared planner the
+    kernel auditor baselines)."""
+    plan = vmem.overlap_plan(m, k_loc, n_loc, ring, shard_axis, itemsize)
+    if not _interpret() and not plan["lane_aligned"]:
         return False
-    wshard = (k_loc // ring) * n_loc if shard_axis == 0 else k_loc * (n_loc // ring)
-    worst = max(
-        # fwd ag: x + f32 out + (ring receive slots + own shard) of w.
-        m * k_loc * itemsize + m * n_loc * 4 + (ring + 1) * wshard * itemsize,
-        # bwd dx ag: dy + f32 dx + the same w slot set.
-        m * n_loc * itemsize + m * k_loc * 4 + (ring + 1) * wshard * itemsize,
-        # bwd dw rs: both operands + f32 (recv slots + stage + out) of dw.
-        m * (k_loc + n_loc) * itemsize + (ring + 1) * wshard * 4,
-    )
-    return worst <= _VMEM_BUDGET_BYTES
+    return plan["fits"]
 
 
 def resolve_backend(
@@ -229,15 +242,27 @@ def _overlap_ag_matmul_kernel(
     ``dma.wait()`` waits BOTH our send and the symmetric incoming copy, so
     reaching step s guarantees chunk ``(idx - s)`` has landed."""
     idx = lax.axis_index(axis_name)
+    _sched("kernel", name="ag_matmul", ring=ring)
     _neighbor_barrier(mesh, axis_name)
     device_id, id_type = _neighbor_device_id(mesh, axis_name, idx)
     dma = None
     for s in range(ring):
         src = lax.rem(idx - s + ring, ring)
         if s > 0:
+            _sched("dma_wait", step=s)
             dma.wait()
         if s < ring - 1:
             src_ref = w_ref if s == 0 else w_slots.at[src]
+            # The copy lands in the RIGHT neighbor's w_slots at the same
+            # chunk index (idx - s), i.e. the slot the neighbor reads at
+            # ITS step s+1 — recorded sender-relative; the auditor
+            # resolves absolute (device, slot) pairs.
+            _sched(
+                "dma_start", step=s,
+                src_buf=("w_own" if s == 0 else "w_slots"),
+                src_slot=(None if s == 0 else ("rel", -s)),
+                dst_buf="w_slots", dst_slot=("rel", -s), dst_device=1,
+            )
             dma = pltpu.make_async_remote_copy(
                 src_ref=src_ref,
                 dst_ref=w_slots.at[src],
@@ -249,11 +274,17 @@ def _overlap_ag_matmul_kernel(
             dma.start()
         # Compute on the chunk while the forward RDMA is in flight — the
         # overlap the serialized all-gather-then-matmul never gets.
+        _sched(
+            "read", step=s,
+            buf=("w_own" if s == 0 else "w_slots"),
+            slot=(None if s == 0 else ("rel", -s)),
+        )
         w_cur = w_ref[...] if s == 0 else w_slots[src]
         xs = (
             x_ref[:, pl.ds(src * blk_in, blk_in)] if slice_x else x_ref[...]
         )
         part = _contract(xs, w_cur, w_t)
+        _sched("write", step=s, buf="o", slot=None)
         if slice_out:
             o_ref[:, pl.ds(src * blk_out, blk_out)] = part
         elif s == 0:
@@ -278,6 +309,7 @@ def _overlap_rs_matmul_kernel(
     stage is safe to rewrite because ``dma.wait()`` covers the previous
     send's completion."""
     idx = lax.axis_index(axis_name)
+    _sched("kernel", name="rs_matmul", ring=ring)
     _neighbor_barrier(mesh, axis_name)
     device_id, id_type = _neighbor_device_id(mesh, axis_name, idx)
     dma = None
@@ -291,10 +323,21 @@ def _overlap_rs_matmul_kernel(
         if s == 0:
             acc = part
         else:
+            _sched("dma_wait", step=s)
             dma.wait()
+            _sched("read", step=s, buf="recv", slot=("abs", s - 1))
             acc = recv_buf[s - 1] + part
         if s < ring - 1:
+            # The stage rewrite is only safe because the wait above also
+            # covered OUR previous send — the exact discipline the
+            # auditor's send-rewrite rule checks.
+            _sched("write", step=s, buf="stage", slot=None)
             stage[...] = acc
+            _sched(
+                "dma_start", step=s,
+                src_buf="stage", src_slot=None,
+                dst_buf="recv", dst_slot=("abs", s), dst_device=1,
+            )
             dma = pltpu.make_async_remote_copy(
                 src_ref=stage,
                 dst_ref=recv_buf.at[s],
@@ -305,6 +348,7 @@ def _overlap_rs_matmul_kernel(
             )
             dma.start()
         else:
+            _sched("write", step=s, buf="o", slot=None)
             o_ref[...] = acc
 
 
@@ -706,10 +750,10 @@ def reduce_scatter_matmul(
                 "pallas" if jax.default_backend() == "tpu" else "decomposed"
             )
     if backend == "pallas":
-        wshard = blk * (n_cols if shard_axis == 0 else k_cols)
-        fits = (
-            m_local * (k_cols + n_cols) * a.dtype.itemsize
-            + (ring + 1) * wshard * 4
+        # Same accounting as overlap_plan's bwd_dw_rs leg — the shared
+        # planner's single implementation (was a third inline copy).
+        fits = vmem.rs_standalone_bytes(
+            m_local, k_cols, n_cols, ring, shard_axis, a.dtype.itemsize
         ) <= _VMEM_BUDGET_BYTES
         if (not _interpret() and blk % _LANE != 0) or not fits:
             backend = "decomposed"

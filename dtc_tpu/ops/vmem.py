@@ -1,0 +1,451 @@
+"""Static VMEM/SMEM planner — ONE byte accounting for every Pallas gate.
+
+Before ISSUE 20 the repo carried two hand-rolled 14 MiB estimators
+(``_VMEM_BUDGET_BYTES`` in decode_fused.py and overlap_collectives.py)
+plus a third inline copy in ``reduce_scatter_matmul`` — three places for
+the same arithmetic to drift. This module is the single implementation:
+
+- **exact per-grid-step byte plans** derived from the kernels' own
+  BlockSpecs + scratch_shapes (the megakernel's specs are literally BUILT
+  from :func:`fused_layers_grid_plan`, so gate and kernel cannot
+  disagree about a block shape);
+- **the gates** every ``supports_*`` / ``_pallas_ok`` routing predicate
+  consults (``dtc_tpu/analysis/kernels.py`` lints that they do);
+- **the committed baselines' fingerprints** — ``analysis/kernels.py``
+  emits these plans per (kernel, ladder rung) under
+  ``analysis/baselines/`` with the report.py drift gate, including the
+  static answer to PR 10's open question: does megakernel cross-layer
+  weight double-buffering fit at each rung (``fits_double_buffered``).
+
+Deliberately jax-free: pure integer arithmetic over config dims, cheap
+enough for routing predicates on every trace and importable from
+anywhere (ops/, analysis/, scripts/) without dependency cycles.
+
+All plans are PIPELINE-RESIDENT accounting: what Mosaic must co-locate
+in VMEM for one grid step (input blocks + output blocks + scratch),
+with in-register transients (score tiles, softmax rows) reported as a
+separate *modeled* term — the 14 MiB budget intentionally sits ~2 MiB
+under the ~16 MB/core of a v5e so single-query transients live in the
+headroom, exactly the convention the old estimators used. Gates price
+only what the old gates priced (weights + cache row, plus the ISSUE-20
+spec-window surcharge RELATIVE to the single-query baseline), so
+routing decisions are unchanged for every previously-supported shape.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+#: Per-grid-step VMEM working-set budget shared by every fused-kernel
+#: gate (was duplicated as ``_VMEM_BUDGET_BYTES`` in decode_fused.py and
+#: overlap_collectives.py). ~16 MB/core on v5e; 14 MiB leaves headroom
+#: for in-register activations, Mosaic's own spill, and semaphores.
+VMEM_BUDGET_BYTES = 14 * 1024 * 1024
+
+#: Mosaic lane width: lane-dim dynamic slices on hardware must be
+#: 128-aligned; interpret mode does not care (how the tiny CPU tests
+#: drive the real kernels).
+LANE = 128
+
+#: Widest speculative verify window the megakernel serves in one launch
+#: (re-exported as ``decode_fused._SPEC_MAX_K``). Tiny by design:
+#: speculation past ~8 proposals is acceptance-rate-limited, and a small
+#: static bound keeps the (t, S) score tiles inside the single-query
+#: VMEM headroom (the gate prices the surcharge — see
+#: :func:`fused_layers_plan`).
+SPEC_MAX_K = 8
+
+#: Longest cache the megakernel holds as one (S, H·D) tile per (layer,
+#: row) grid step (re-exported as ``decode_fused._FUSED_LAYERS_MAX_S``).
+FUSED_LAYERS_MAX_S = 4096
+
+#: LoRA dense sites the megakernel threads factors for, with their
+#: (in, out) dims as functions of (d_model, H·D, d_ff) — the same
+#: canonical order as ``decode_fused._LORA_ATTN_SITES + _LORA_MLP_SITES``.
+LORA_SITES = ("q_proj", "k_proj", "v_proj", "out_proj", "fc1", "fc2")
+
+#: The megakernel's 16 per-layer weight blocks — the layer-streamed
+#: class whose index maps MUST be b-invariant ("weights re-fetch per
+#: layer, not per row"); shared by the byte plan and the kernel lint.
+WEIGHT_BLOCK_NAMES = frozenset({
+    "ln1_scale", "ln1_bias", "wq", "bq", "wk", "bk", "wv", "bv",
+    "wo", "bo", "ln2_scale", "ln2_bias", "w1", "b1", "w2", "b2",
+})
+
+
+def _dtype_bytes(name: str) -> int:
+    from dtc_tpu.config.schema import DTYPE_BYTES
+
+    return DTYPE_BYTES.get(name, 4)
+
+
+def _prod(shape) -> int:
+    n = 1
+    for d in shape:
+        n *= int(d)
+    return n
+
+
+def packed_group(d: int, h: int) -> tuple[int, int]:
+    """(heads per lane block, lane block width) — the packed-layout
+    grouping rule of ``flash_attention._packed_group`` /
+    ``decode_attention._group`` (mirrored here so the planner stays
+    jax-free; tests/test_kernel_audit.py pins the two against each
+    other). 128-lane groups when head_dim divides the lane width and the
+    group divides the head count; otherwise ONE block of all H·D lanes
+    (tiny-model shapes, Mosaic pads internally)."""
+    if d <= LANE and LANE % d == 0 and h % (LANE // d) == 0:
+        return LANE // d, LANE
+    return h, h * d
+
+
+def _lora_dims(cfg) -> dict[str, tuple[int, int]]:
+    dm, ff = cfg.d_model, cfg.d_ff
+    hd = cfg.n_heads * cfg.head_dim
+    return {
+        "q_proj": (dm, hd), "k_proj": (dm, hd), "v_proj": (dm, hd),
+        "out_proj": (hd, dm), "fc1": (dm, ff), "fc2": (ff, dm),
+    }
+
+
+def lora_sites_for(cfg) -> tuple[str, ...]:
+    """The megakernel LoRA sites a config's adapter targets (canonical
+    order; empty when adapters are off or the model is MoE — expert MLPs
+    carry no fc1/fc2 dense sites)."""
+    ad = getattr(cfg, "adapter", None)
+    if ad is None or ad.rank <= 0:
+        return ()
+    targets = set(ad.target_modules)
+    sites = [s for s in LORA_SITES if s in targets]
+    if cfg.moe_experts > 0:
+        sites = [s for s in sites if s not in ("fc1", "fc2")]
+    return tuple(sites)
+
+
+# ---------------------------------------------------------------------------
+# decode megakernel (ops/decode_fused.py)
+# ---------------------------------------------------------------------------
+
+
+def fused_layers_grid_plan(
+    cfg, t: int = 1, b: int = 1,
+    lora_sites: tuple[str, ...] = (), lora_per_row: bool = False,
+) -> dict[str, Any]:
+    """The megakernel's grid/BlockSpec layout, symbolically.
+
+    This is the SOURCE of ``decode_fused._fused_layers_call``'s specs —
+    the kernel wrapper converts these entries into ``pl.BlockSpec``s, so
+    the byte plan below and the launched kernel share one definition of
+    every block shape and index map. Returns::
+
+        {"grid": (L, b),
+         "in_specs":  [(name, block_shape|None, index_map|None,
+                        space, dtype_bytes), ...],
+         "out_specs": [...same...],
+         "scratch":   [(shape, dtype_bytes), ...]}
+
+    ``block_shape is None`` means whole-array (the SMEM frontier).
+    Index maps are plain callables of the grid coords ``(l, bb)`` —
+    pure, and b-invariant exactly for the weight blocks (the "weights
+    re-fetch per layer, not per row" pipelining contract
+    ``analysis/kernels.py`` lints)."""
+    dm, ff, H = cfg.d_model, cfg.d_ff, cfg.n_heads
+    hd = H * cfg.head_dim
+    L, S = cfg.n_layers, cfg.max_seq_len
+    pb = _dtype_bytes(cfg.param_dtype)
+    cb = _dtype_bytes(cfg.compute_dtype)
+    quant = cfg.kv_quantized
+    kvb = 1 if quant else _dtype_bytes(cfg.kv_store_dtype)
+
+    def wmap(rank):
+        return lambda l, bb, _r=rank: (l,) + (0,) * (_r - 1)
+
+    row4 = lambda l, bb: (l, bb, 0, 0)  # noqa: E731
+    xmap = lambda l, bb: (bb, 0, 0)     # noqa: E731
+
+    weight_feats = [
+        ("ln1_scale", (dm,)), ("ln1_bias", (dm,)),
+        ("wq", (dm, hd)), ("bq", (hd,)),
+        ("wk", (dm, hd)), ("bk", (hd,)),
+        ("wv", (dm, hd)), ("bv", (hd,)),
+        ("wo", (hd, dm)), ("bo", (dm,)),
+        ("ln2_scale", (dm,)), ("ln2_bias", (dm,)),
+        ("w1", (dm, ff)), ("b1", (ff,)),
+        ("w2", (ff, dm)), ("b2", (dm,)),
+    ]
+    in_specs: list[tuple] = [
+        ("frontier", None, None, "smem", 4),
+        ("x", (1, t, dm), xmap, "vmem", cb),
+    ]
+    for name, feat in weight_feats:
+        shape = (1,) + feat
+        in_specs.append((name, shape, wmap(len(shape)), "vmem", pb))
+    in_specs += [
+        ("k_row", (1, 1, S, hd), row4, "vmem", kvb),
+        ("v_row", (1, 1, S, hd), row4, "vmem", kvb),
+    ]
+    if quant:
+        in_specs += [
+            ("k_scale_row", (1, 1, S, H), row4, "vmem", 4),
+            ("v_scale_row", (1, 1, S, H), row4, "vmem", 4),
+        ]
+    rank = getattr(getattr(cfg, "adapter", None), "rank", 0)
+    dims = _lora_dims(cfg)
+    for site in lora_sites:
+        din, dout = dims[site]
+        for suffix, shp in (("a", (din, rank)), ("b", (rank, dout))):
+            if lora_per_row:
+                spec = (f"{site}_{suffix}", (1, 1) + shp, row4, "vmem", 4)
+            else:
+                full = (1,) + shp
+                spec = (f"{site}_{suffix}", full, wmap(len(full)), "vmem", 4)
+            in_specs.append(spec)
+
+    out_specs = [
+        ("x_out", (1, t, dm), xmap, "vmem", cb),
+        ("k_new", (1, 1, t, hd), row4, "vmem", kvb),
+        ("v_new", (1, 1, t, hd), row4, "vmem", kvb),
+    ]
+    if quant:
+        out_specs += [
+            ("k_scale_new", (1, 1, t, H), row4, "vmem", 4),
+            ("v_scale_new", (1, 1, t, H), row4, "vmem", 4),
+        ]
+    return {
+        "grid": (L, b),
+        "in_specs": in_specs,
+        "out_specs": out_specs,
+        "scratch": [((max(b, 8), t, dm), cb)],
+    }
+
+
+def fused_layers_plan(cfg, t: int = 1, b: int = 1) -> dict[str, Any]:
+    """Exact per-grid-step VMEM/SMEM byte plan for the decode megakernel
+    at verify-window width ``t`` (1 = plain decode) and batch ``b``.
+
+    Components (bytes, all per (layer, row) grid step):
+
+    - ``weights`` — one layer's 16 stacked blocks, param dtype. Exact
+      per-tensor shapes (the old estimator's ``4·(d² + d)`` assumed
+      ``H·D == d_model``; q/k/v/out are really ``(d, H·D)``/``(H·D, d)``).
+    - ``cache_row`` — one row's K/V tiles (+ fp32 scales when int8).
+    - ``lora`` — the targeted sites' factor blocks (per-row and shared
+      layouts stream identical bytes per step: one layer's (in, r) pair
+      either way).
+    - ``io`` — the x/x_out blocks and the t frontier cache-write blocks
+      (+ scale writes) — the per-step t-proportional traffic PR 19 added.
+    - ``scratch`` — the residual-carry VMEM scratch, ``(max(b,8), t, dm)``.
+    - ``smem`` — the frontier scalars.
+    - ``modeled_transients`` — in-register score/softmax tiles
+      (``2·t·S·4 + 2·t²·4`` fp32 per head iteration), NOT BlockSpec
+      bytes: reported for honesty, lives in the budget's headroom.
+
+    Gate semantics (``gate_bytes``): the historical rule priced
+    ``weights + cache_row`` against the budget with single-query io/
+    transients absorbed by the 2 MiB headroom. The ISSUE-20 fix keeps
+    that calibration and adds the SPEC-WINDOW SURCHARGE — the t-driven
+    growth of io + scratch + transients RELATIVE to t=1 (k query/score
+    rows, k cache writes per layer) — so a verify window cannot ride a
+    gate that only priced one query row. ``fits`` folds in the MoE and
+    single-tile-cache structural bounds: it IS ``supports_fused_layers``.
+
+    ``fits_double_buffered`` answers PR 10's open question statically:
+    2× every streamed block (weights, cache row, LoRA, io — Mosaic
+    prefetches grid step n+1 while n computes) + scratch + smem under
+    the budget."""
+    S = cfg.max_seq_len
+
+    def _transients(tt: int) -> int:
+        return 2 * tt * S * 4 + 2 * tt * tt * 4
+
+    def _groups(tt: int) -> dict[str, int]:
+        plan = fused_layers_grid_plan(
+            cfg, t=tt, b=b, lora_sites=lora_sites_for(cfg),
+            lora_per_row=False,
+        )
+        groups: dict[str, int] = {
+            "weights": 0, "cache_row": 0, "lora": 0, "io": 0,
+            "scratch": 0, "smem": 0,
+        }
+        weight_names = {
+            "ln1_scale", "ln1_bias", "wq", "bq", "wk", "bk", "wv", "bv",
+            "wo", "bo", "ln2_scale", "ln2_bias", "w1", "b1", "w2", "b2",
+        }
+        for name, shape, _imap, space, nbytes in plan["in_specs"]:
+            if space == "smem":
+                groups["smem"] += nbytes * max(b, 1)
+            elif name in weight_names:
+                groups["weights"] += _prod(shape) * nbytes
+            elif name.endswith(("_a", "_b")):
+                groups["lora"] += _prod(shape) * nbytes
+            elif name in ("k_row", "v_row", "k_scale_row", "v_scale_row"):
+                groups["cache_row"] += _prod(shape) * nbytes
+            else:
+                groups["io"] += _prod(shape) * nbytes
+        for name, shape, _imap, _space, nbytes in plan["out_specs"]:
+            groups["io"] += _prod(shape) * nbytes
+        for shape, nbytes in plan["scratch"]:
+            groups["scratch"] += _prod(shape) * nbytes
+        return groups
+
+    groups = _groups(t)
+    transients = _transients(t)
+    base = _groups(1)
+    # The t-driven growth of io + scratch + in-register transients over
+    # the single-query baseline — derived from the SAME grid plan the
+    # kernel launches with, not a parallel formula.
+    surcharge = (
+        (groups["io"] - base["io"])
+        + (groups["scratch"] - base["scratch"])
+        + (transients - _transients(1))
+    )
+    gate_bytes = groups["weights"] + groups["cache_row"] + surcharge
+    per_step = sum(groups.values())
+    streamed = (
+        groups["weights"] + groups["cache_row"] + groups["lora"]
+        + groups["io"]
+    )
+    db_bytes = 2 * streamed + groups["scratch"] + groups["smem"]
+    structural = cfg.moe_experts == 0 and S <= FUSED_LAYERS_MAX_S
+    return {
+        "kernel": "fused_layers",
+        "grid": [cfg.n_layers, b],
+        "t": t,
+        "bytes": dict(groups),
+        "per_step_bytes": per_step,
+        "modeled_transient_bytes": transients,
+        "spec_surcharge_bytes": surcharge,
+        "gate_bytes": gate_bytes,
+        "budget_bytes": VMEM_BUDGET_BYTES,
+        "fits": structural and gate_bytes <= VMEM_BUDGET_BYTES,
+        "double_buffered_bytes": db_bytes,
+        "fits_double_buffered": structural and db_bytes <= VMEM_BUDGET_BYTES,
+    }
+
+
+# ---------------------------------------------------------------------------
+# per-layer decode kernels (ops/decode_attention.py)
+# ---------------------------------------------------------------------------
+
+
+def decode_single_plan(cfg, s: int | None = None) -> dict[str, Any]:
+    """Per-grid-step bytes of the single-tile decode kernel: grid
+    ``(B, H·D/lane_block)``, one program holds q (1,1,lb), the full
+    (1,s,lb) K and V tiles (+ (1,s,g) fp32 scale columns when int8) and
+    the (1,1,lb) output. No scratch."""
+    if s is None:
+        s = cfg.max_seq_len
+    g, lb = packed_group(cfg.head_dim, cfg.n_heads)
+    cb = _dtype_bytes(cfg.compute_dtype)
+    quant = cfg.kv_quantized
+    kvb = 1 if quant else _dtype_bytes(cfg.kv_store_dtype)
+    kv = 2 * s * lb * kvb
+    scales = 2 * s * g * 4 if quant else 0
+    io = 2 * lb * cb  # q block + output block
+    total = kv + scales + io
+    return {
+        "kernel": "decode_single", "s": s, "lane_block": lb, "group": g,
+        "bytes": {"kv_tiles": kv, "scales": scales, "io": io, "scratch": 0},
+        "per_step_bytes": total,
+        "budget_bytes": VMEM_BUDGET_BYTES,
+        "fits": total <= VMEM_BUDGET_BYTES,
+    }
+
+
+def decode_blocked_plan(
+    cfg, s: int | None = None, block_s: int = 512,
+) -> dict[str, Any]:
+    """Per-grid-step bytes of the blocked (online-softmax) decode
+    kernel: KV walks in ``block_s`` chunks; scratch carries the running
+    max/sum (two (8, 128) fp32 rows) and the (8, lane_block) fp32 output
+    accumulator."""
+    if s is None:
+        s = cfg.max_seq_len
+    g, lb = packed_group(cfg.head_dim, cfg.n_heads)
+    cb = _dtype_bytes(cfg.compute_dtype)
+    quant = cfg.kv_quantized
+    kvb = 1 if quant else _dtype_bytes(cfg.kv_store_dtype)
+    kv = 2 * block_s * lb * kvb
+    scales = 2 * block_s * g * 4 if quant else 0
+    io = 2 * lb * cb
+    scratch = 2 * 8 * LANE * 4 + 8 * lb * 4
+    total = kv + scales + io + scratch
+    return {
+        "kernel": "decode_blocked", "s": s, "block_s": block_s,
+        "lane_block": lb, "group": g,
+        "bytes": {"kv_tiles": kv, "scales": scales, "io": io,
+                  "scratch": scratch},
+        "per_step_bytes": total,
+        "budget_bytes": VMEM_BUDGET_BYTES,
+        "fits": total <= VMEM_BUDGET_BYTES,
+    }
+
+
+def decode_single_tile_fits(s: int, lanes: int = LANE) -> bool:
+    """Worst-case (fp32 payload, 128-lane block) single-tile fit for a
+    cache of length ``s`` — the VMEM leg of ``decode_attention.supports``
+    (the structural ``s <= _DECODE_MAX_SINGLE_S`` bound remains the
+    caller's; at the 14 MiB budget every single-tile-bounded cache fits,
+    pinned in tests so the gate refactor cannot change routing)."""
+    return 2 * s * lanes * 4 + 2 * s * 4 <= VMEM_BUDGET_BYTES
+
+
+# ---------------------------------------------------------------------------
+# ring collective kernels (ops/overlap_collectives.py)
+# ---------------------------------------------------------------------------
+
+
+def overlap_plan(
+    m: int, k_loc: int, n_loc: int, ring: int, shard_axis: int,
+    itemsize: int,
+) -> dict[str, Any]:
+    """Per-launch VMEM byte plan for the fused ring kernels, all three
+    launches one backend decision covers (the PR 11 worst-of-three rule:
+    fwd all-gather-matmul, bwd dx re-gather, bwd dw matmul+reduce-
+    scatter). Shapes are the LOCAL shard_map-region shapes; ``m`` =
+    flattened token rows per device.
+
+    - fwd ag: x (m, k_loc) + fp32 out (m, n_loc) + the (ring receive
+      slots + own shard) weight scratch.
+    - bwd dx ag: dy (m, n_loc) + fp32 dx (m, k_loc) + the same slot set.
+    - bwd dw rs: both operands + fp32 (recv slots + stage + out) of dw
+      (:func:`rs_standalone_bytes` — also ``reduce_scatter_matmul``'s
+      own gate)."""
+    blk = (k_loc if shard_axis == 0 else n_loc) // ring
+    wshard = (
+        (k_loc // ring) * n_loc if shard_axis == 0
+        else k_loc * (n_loc // ring)
+    )
+    slots = (ring + 1) * wshard
+    legs = {
+        "fwd_ag": m * k_loc * itemsize + m * n_loc * 4 + slots * itemsize,
+        "bwd_dx_ag": m * n_loc * itemsize + m * k_loc * 4 + slots * itemsize,
+        "bwd_dw_rs": rs_standalone_bytes(
+            m, k_loc, n_loc, ring, shard_axis, itemsize
+        ),
+    }
+    worst = max(legs.values())
+    return {
+        "kernel": "overlap_ring",
+        "m": m, "k_loc": k_loc, "n_loc": n_loc, "ring": ring,
+        "shard_axis": shard_axis, "itemsize": itemsize,
+        "block": blk,
+        "lane_aligned": blk % LANE == 0,
+        "wshard_bytes": wshard * itemsize,
+        "legs": legs,
+        "worst_bytes": worst,
+        "budget_bytes": VMEM_BUDGET_BYTES,
+        "fits": worst <= VMEM_BUDGET_BYTES,
+    }
+
+
+def rs_standalone_bytes(
+    m: int, k_cols: int, n_cols: int, ring: int, shard_axis: int,
+    itemsize: int,
+) -> int:
+    """The streamed matmul+reduce-scatter launch working set: both
+    operands + fp32 (ring-1 recv slots + stage + out) ≈ (ring+1) blocks
+    of the scattered product."""
+    blk = (k_cols if shard_axis == 0 else n_cols) // ring
+    wshard = blk * (n_cols if shard_axis == 0 else k_cols)
+    return m * (k_cols + n_cols) * itemsize + (ring + 1) * wshard * 4

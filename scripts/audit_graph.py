@@ -80,6 +80,15 @@ def main() -> int:
         "--no-memory", dest="memory", action="store_false",
     )
     p.add_argument(
+        "--kernels", action="store_true",
+        help="run the ISSUE-20 kernel audit: DMA happens-before race "
+        "detection over the recorded ring-kernel schedules, the static "
+        "VMEM plans for every Pallas kernel across the model ladder "
+        "(gating the kernels_<rung>.json baselines), and the index-map/"
+        "SMEM/gate-coverage lint family. Combine with --modes '' "
+        "--no-numerics --no-memory for the kernel-only pre-gate",
+    )
+    p.add_argument(
         "--check-baselines", action="store_true",
         help="fail when a committed baseline is missing (drift always "
         "checks against whatever baselines exist)",
@@ -163,6 +172,41 @@ def main() -> int:
         else:
             print(f"[audit] memory_stats watermark: {watermark:,} bytes")
 
+    kreport = None
+    if args.kernels:
+        from dtc_tpu.analysis import kernels as kern
+
+        kfindings, kreport = kern.run_kernel_audit(
+            write_baseline=args.write_baseline,
+            require_baselines=args.check_baselines,
+        )
+        findings.extend(kfindings)
+        errs = sum(1 for f in kfindings if f.severity == "error")
+        print(f"[audit] kernel audit: {len(kfindings)} finding(s) "
+              f"({errs} error) over race detector + lints + "
+              f"{len(kreport['rungs'])} ladder rung(s)")
+        for rung, fp in kreport["rungs"].items():
+            t1 = fp["kernels"]["fused_layers_t1"]
+            # PR 10's open double-buffer question, answered statically
+            # per rung — the same verdict the committed baseline pins.
+            print(
+                f"[audit]   {rung}: megakernel gate {t1['gate_bytes']:,} B "
+                f"({'fits' if t1['fits'] else 'NO FIT'} @ "
+                f"{t1['budget_bytes']:,}), double-buffered "
+                f"{t1['double_buffered_bytes']:,} B "
+                f"({'fits' if t1['fits_double_buffered'] else 'no fit'})"
+            )
+            fitting = [
+                s[len("overlap_"):]
+                for s in sorted(fp["kernels"]) if s.startswith("overlap_")
+                and fp["kernels"][s]["fits"]
+            ]
+            print(f"[audit]   {rung}: overlap-ring sites fitting: "
+                  f"{', '.join(fitting) if fitting else 'none'}")
+        if args.write_baseline:
+            for path in kreport.get("written", []):
+                print(f"[audit] baseline written: {path}")
+
     report = build_report(artifacts, findings, sections=sections)
 
     if args.write_baseline:
@@ -172,6 +216,9 @@ def main() -> int:
         drift = check_baselines(report, require=args.check_baselines)
         findings.extend(drift)
         report = build_report(artifacts, findings, sections=sections)
+
+    if kreport is not None:
+        report["kernels"] = kreport["rungs"]
 
     if args.report:
         with open(args.report, "w") as f:

@@ -17,8 +17,8 @@ timeout -k 10 120 env JAX_PLATFORMS=cpu python -m pytest --collect-only -q -p no
   tests/test_serve.py tests/test_trace.py tests/test_devprof.py \
   tests/test_adapters.py tests/test_overlap_collectives.py \
   tests/test_router.py tests/test_elastic.py tests/test_goodput.py \
-  tests/test_pool.py tests/test_spec.py > /dev/null || {
-    echo "tier-1 pre-gate: MoE/HLO/decode/analysis/serve/trace/devprof/adapters/overlap/router/elastic/goodput/pool/spec test collection failed" >&2; exit 1; }
+  tests/test_pool.py tests/test_spec.py tests/test_kernel_audit.py > /dev/null || {
+    echo "tier-1 pre-gate: MoE/HLO/decode/analysis/serve/trace/devprof/adapters/overlap/router/elastic/goodput/pool/spec/kernel-audit test collection failed" >&2; exit 1; }
 # Pre-gate 2 (ISSUE 5 + 6): the graph audit — lower/compile the
 # dp/tp/fsdp/ep train steps (8-virtual-device CPU mesh), the greedy decode
 # scan, AND the serving (continuous-batching) decode step; run the rule
@@ -148,4 +148,16 @@ timeout -k 10 480 env JAX_PLATFORMS=cpu python scripts/pool_smoke.py --chaos || 
 # spec_rejected_draft class). ~1-2 min.
 timeout -k 10 300 env JAX_PLATFORMS=cpu python scripts/spec_smoke.py || {
     echo "tier-1 pre-gate: speculative-decoding smoke failed" >&2; exit 1; }
+# Pre-gate 12 (ISSUE 20): the kernel audit — DMA happens-before race
+# detection over the recorded ring-kernel schedules (the concurrency
+# discipline interpret mode's serialized execution cannot test), the
+# static VMEM/SMEM plans for every Pallas kernel across the model
+# ladder gated on the committed kernels_<rung>.json baselines
+# (flagship / ladder_350m / ladder_1b — including the static megakernel
+# double-buffer verdict), and the index-map/SMEM/gate-coverage lint
+# family. Kernel-only invocation (--modes '' + section opt-outs): the
+# train/decode/serve graph entries are pre-gate 2's job. ~1 min.
+timeout -k 10 600 env JAX_PLATFORMS=cpu python scripts/audit_graph.py \
+  --kernels --modes '' --no-numerics --no-memory --check-baselines || {
+    echo "tier-1 pre-gate: kernel audit failed (see findings above)" >&2; exit 1; }
 set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc
